@@ -1,0 +1,29 @@
+"""attacking_federate_learning_tpu — a TPU-native federated-learning
+attack/defense simulation framework.
+
+A ground-up JAX / XLA / pjit re-design of the capabilities of
+``shaneson0/attacking_federate_learning`` (synchronous federated SGD under
+Byzantine attack: ALIE drift + clipped backdoors vs. Krum / TrimmedMean /
+Bulyan / plain averaging).  Unlike the reference's sequential single-process
+simulator (reference server.py:54-56 — a Python ``for`` over client objects),
+the client axis here is an array dimension: the local step is
+``vmap(grad(loss))`` over stacked client batches, sharded across TPU devices
+with ``jax.sharding``, and the defense kernels are compiled XLA (Krum's
+O(n^2·d) pairwise distances as one matmul).
+
+Layer map (mirrors SURVEY.md §1):
+
+- ``cli``        — L6 experiment driver
+- ``attacks``    — L5 attack plugins (pure ``craft`` functions)
+- ``core``       — L4 server runtime / round loop
+- ``defenses``   — L3 robust-aggregation kernels
+- ``data``       — L2 client data feeding (partitioners, batch gathers)
+- ``models``     — L1 model zoo (torch-parameter-order compatible pytrees)
+- ``parallel``   — device mesh / sharding layouts (no reference analog:
+  the reference has no distributed backend, SURVEY.md §2.3)
+- ``ops``        — low-level kernels (pairwise distances, sorting helpers)
+"""
+
+__version__ = "0.1.0"
+
+from attacking_federate_learning_tpu.config import ExperimentConfig  # noqa: F401

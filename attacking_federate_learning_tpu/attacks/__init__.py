@@ -1,0 +1,30 @@
+from attacking_federate_learning_tpu.attacks.base import (  # noqa: F401
+    Attack, AttackContext, NoAttack, cohort_stats
+)
+from attacking_federate_learning_tpu.attacks.alie import DriftAttack  # noqa: F401
+from attacking_federate_learning_tpu.utils.registry import Registry
+
+# Factories with the uniform signature (cfg, dataset) -> Attack, so new
+# attacks plug in the way new defenses do (the reference hardwires its two
+# attacks at main.py:44-54).
+ATTACKS = Registry("attack")
+ATTACKS.register("none", lambda cfg, dataset=None: NoAttack())
+ATTACKS.register("alie", lambda cfg, dataset=None: DriftAttack(cfg.num_std))
+
+
+def _make_backdoor(cfg, dataset=None):
+    from attacking_federate_learning_tpu.attacks.backdoor import (
+        BackdoorAttack
+    )
+    return BackdoorAttack(cfg, dataset=dataset)
+
+
+ATTACKS.register("backdoor", _make_backdoor)
+
+
+def make_attacker(cfg, dataset=None, name=None):
+    """Attack selection mirroring reference main.py:44-54: a backdoor option
+    picks BackdoorAttack, otherwise ALIE DriftAttack."""
+    if name is None:
+        name = "backdoor" if cfg.backdoor else "alie"
+    return ATTACKS[name](cfg, dataset=dataset)

@@ -1,0 +1,21 @@
+"""'A Little Is Enough' (ALIE) mean-shift drift attack.
+
+Reference ``DriftAttack`` (malicious.py:30-36): the crafted gradient is the
+malicious cohort's mean shifted down by z standard deviations per coordinate,
+``mean - z * sigma`` (the reference mutates grads_mean in place; the value is
+identical).  z is the fixed CLI constant num_std (default 1.5, reference
+main.py:109-110) — the reference does not derive the paper's z_max from the
+phi-quantile formula, and neither does this default path (SURVEY.md §2.4 #3).
+"""
+
+from __future__ import annotations
+
+from attacking_federate_learning_tpu.attacks.base import Attack, cohort_stats
+
+
+class DriftAttack(Attack):
+    name = "alie"
+
+    def craft(self, mal_grads, ctx=None):
+        mean, stdev = cohort_stats(mal_grads)
+        return mean - self.num_std * stdev

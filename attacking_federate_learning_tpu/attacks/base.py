@@ -1,0 +1,74 @@
+"""Attack framework.
+
+The reference's attack seam is ``Attack.attack(mal_users)`` called once per
+round between client compute and gradient collection (reference main.py:66-68,
+malicious.py:10-27): it computes the mean and population std of the malicious
+cohort's *honest* gradients, asks the subclass for one crafted vector, and
+overwrites every malicious client's gradient with that same vector
+(malicious.py:26-27).
+
+Here the seam is functional: ``craft(mal_grads (m, d), ctx) -> (d,)``
+produces the crafted vector and the engine broadcasts it into the first f
+rows of the (n, d) gradient matrix (malicious clients are the first f ids,
+reference main.py:28).  ``ctx`` carries what the reference stashes on user 0
+(user.py:84-86): the round's broadcast weights and the faded learning rate.
+
+``num_std == 0`` disables crafting and leaves the honest gradients in place
+(reference malicious.py:21-22).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class AttackContext(NamedTuple):
+    original_params: jax.Array   # (d,) weights broadcast this round
+    learning_rate: jax.Array     # faded lr (reference server.py:50-52)
+
+
+def cohort_stats(mal_grads):
+    """Mean and population std over the malicious cohort
+    (reference malicious.py:18-19: np.var ** 0.5, i.e. ddof=0)."""
+    mean = jnp.mean(mal_grads, axis=0)
+    stdev = jnp.sqrt(jnp.var(mal_grads, axis=0))
+    return mean, stdev
+
+
+class Attack:
+    """Base class; subclasses implement ``craft``."""
+
+    name = "none"
+
+    def __init__(self, num_std: float):
+        self.num_std = num_std
+
+    def craft(self, mal_grads, ctx: AttackContext):
+        """(m, d) honest malicious-cohort grads -> (d,) crafted vector."""
+        raise NotImplementedError
+
+    def apply(self, users_grads, corrupted_count: int,
+              ctx: Optional[AttackContext] = None):
+        """Full seam: returns users_grads with the first f rows replaced.
+
+        No-ops when there are no malicious users (reference malicious.py:11)
+        or num_std == 0 (malicious.py:21).
+        """
+        f = corrupted_count
+        if f == 0 or self.num_std == 0:
+            return users_grads
+        crafted = self.craft(users_grads[:f], ctx)
+        return users_grads.at[:f].set(crafted[None, :])
+
+
+class NoAttack(Attack):
+    name = "none"
+
+    def __init__(self):
+        super().__init__(num_std=0.0)
+
+    def apply(self, users_grads, corrupted_count, ctx=None):
+        return users_grads

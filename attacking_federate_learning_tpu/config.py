@@ -1,0 +1,132 @@
+"""Experiment configuration.
+
+One dataclass surfaces every knob of the reference, including the constants
+hardcoded after argparse in reference main.py:138-149 (momentum 0.9,
+mal_epochs 5, alpha 4, per-dataset fading_rate) and defaults buried in
+signatures (reference main.py:12 batch_size=83 vs CLI default 128;
+backdoor.py:14 BackdoorAttack(batch_size=200, learning_rate=0.1)).
+
+Reference-behavior parity quirks (SURVEY.md §2.4) are explicit flags with the
+reference behavior as the default, so a run is reproducible against the
+reference while the paper-faithful behavior stays one flag away.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+MNIST = "MNIST"
+CIFAR10 = "CIFAR10"
+CIFAR100 = "CIFAR100"
+SYNTH_MNIST = "SYNTH_MNIST"      # MNIST-shaped deterministic synthetic data
+SYNTH_CIFAR10 = "SYNTH_CIFAR10"  # CIFAR10-shaped deterministic synthetic data
+
+# Per-dataset LR fading constants, reference main.py:144-149.
+FADING_RATES = {CIFAR10: 2000.0, MNIST: 10000.0, CIFAR100: 1500.0,
+                SYNTH_MNIST: 10000.0, SYNTH_CIFAR10: 2000.0}
+
+
+@dataclasses.dataclass
+class ExperimentConfig:
+    # --- topology -------------------------------------------------------
+    users_count: int = 10            # reference main.py:118
+    mal_prop: float = 0.24           # reference main.py:106
+    dataset: str = MNIST             # reference main.py:114
+    model: Optional[str] = None      # default: dataset's canonical model
+
+    # --- optimization ---------------------------------------------------
+    learning_rate: float = 0.1       # server base lr, reference main.py:127
+    fading_rate: Optional[float] = None  # None -> FADING_RATES[dataset]
+    momentum: float = 0.9            # reference main.py:138
+    batch_size: int = 128            # reference main.py:121
+    epochs: int = 300                # rounds, reference main.py:124
+
+    # --- attack ---------------------------------------------------------
+    num_std: float = 1.5             # ALIE z, reference main.py:109
+    backdoor: object = False         # False | 'pattern' | int sample index
+    alpha: float = 4.0               # anchor-loss weight, reference main.py:142
+    mal_epochs: int = 5              # shadow-net epochs, reference main.py:139
+    mal_batch_size: int = 200        # reference backdoor.py:14
+    mal_learning_rate: float = 0.1   # shadow SGD lr, reference backdoor.py:132
+    mal_weight_decay: float = 1e-4   # reference backdoor.py:132
+    # (the reference's shadow-SGD momentum is inert — fresh optimizer per
+    # batch, backdoor.py:132 — so it is not a knob here)
+
+    # --- defense --------------------------------------------------------
+    defense: str = "NoDefense"       # reference main.py:112
+
+    # --- evaluation / io ------------------------------------------------
+    test_step: int = 5               # reference main.py:58
+    checkpoint_acc_threshold: float = 70.0  # reference main.py:84
+    output: Optional[str] = None     # tee file, reference main.py:13-18
+    log_dir: str = "logs"
+    run_dir: str = "runs"
+    data_dir: str = "data"           # raw MNIST idx / CIFAR pickle location
+
+    # --- determinism ----------------------------------------------------
+    # The reference seeds only the metadata split (random_state=42,
+    # user.py:65); everything else (init, shard permutation) is implicit.
+    # Here every random choice flows from this seed (SURVEY.md §2.4 #13).
+    seed: int = 0
+
+    # --- data partition -------------------------------------------------
+    partition: str = "iid"           # 'iid' (DistributedSampler-equivalent,
+                                     # reference user.py:49-54) | 'dirichlet'
+    dirichlet_alpha: float = 0.5
+
+    # --- backend / parallelism -----------------------------------------
+    backend: str = "auto"            # 'auto' | 'cpu' | 'tpu'
+    mesh_shape: Optional[tuple] = None  # (clients_devices, model_devices);
+                                        # None -> all devices on client axis
+    grad_dtype: str = "float32"      # dtype of the (n, d) gradient matrix;
+                                     # 'bfloat16' halves HBM at large n
+                                     # (distances still accumulate in f32)
+
+    # --- reference-parity quirk flags (SURVEY.md §2.4) ------------------
+    # Server momentum step uses the *constant* base lr, not the faded lr
+    # (reference server.py:89 — the faded lr reaches only the clients'
+    # never-stepped optimizers and the attacker's arithmetic).
+    server_uses_faded_lr: bool = False
+    # Krum scores sum the n-f smallest distances (reference defences.py:26,
+    # 33-34) rather than the paper's n-f-2.
+    krum_paper_scoring: bool = False
+    # Attack statistics over the malicious cohort only (reference
+    # malicious.py:14-19), matching the ALIE threat model.
+
+    # --- metadata subsystem (reference C12, vestigial there) ------------
+    collect_metadata: bool = False
+    metadata_fraction: float = 0.11  # reference user.py:65 test_size=0.11
+
+    def __post_init__(self):
+        if self.fading_rate is None:
+            self.fading_rate = FADING_RATES.get(self.dataset, 10000.0)
+        if self.model is None:
+            self.model = default_model_for(self.dataset)
+        if self.backdoor == "No":
+            self.backdoor = False  # reference main.py:135-136
+        elif isinstance(self.backdoor, str) and self.backdoor.isdigit():
+            # reference main.py:116 leaves '1'|'2'|'3' as strings, which
+            # crashes at backdoor.py:34 (str - int); we coerce instead.
+            self.backdoor = int(self.backdoor)
+
+    @property
+    def corrupted_count(self) -> int:
+        # reference main.py:21 / server.py:87
+        return int(self.mal_prop * self.users_count)
+
+    def csv_name(self) -> str:
+        # Filename schema of reference main.py:100.
+        return ("{}_stdev_{}_{}_backdoor-{}_mal_prop_{}_users_{}_alpha_{}_lr_{}"
+                ".csv").format(self.dataset, self.num_std, self.defense,
+                               self.backdoor, self.mal_prop, self.users_count,
+                               self.alpha, self.learning_rate)
+
+
+def default_model_for(dataset: str) -> str:
+    return {
+        MNIST: "mnist_mlp", SYNTH_MNIST: "mnist_mlp",
+        CIFAR10: "cifar10_cnn", SYNTH_CIFAR10: "cifar10_cnn",
+        CIFAR100: "wideresnet40_4",
+    }.get(dataset, "mnist_mlp")

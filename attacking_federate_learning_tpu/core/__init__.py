@@ -1,0 +1,6 @@
+from attacking_federate_learning_tpu.core.engine import (  # noqa: F401
+    FederatedExperiment
+)
+from attacking_federate_learning_tpu.core.server import (  # noqa: F401
+    ServerState, faded_learning_rate, init_server_state, momentum_update
+)

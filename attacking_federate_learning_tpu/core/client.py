@@ -1,0 +1,43 @@
+"""Batched client computation.
+
+The reference runs N sequential ``User.step`` calls per round, each loading
+the broadcast weights into a private net copy and doing one minibatch
+forward/backward with no local optimizer step (reference server.py:54-56,
+user.py:83-92).  Here the entire client population is one call:
+
+    grads = vmap(grad(loss))(broadcast_weights, client_xs, client_ys)
+
+over stacked per-client batches, returning the (n, d) flat gradient matrix
+directly in wire format.  Under pjit the client axis shards across devices
+(parallel/), which is the TPU-native form of the reference's simulated data
+parallelism (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from attacking_federate_learning_tpu.models.base import Model
+from attacking_federate_learning_tpu.models.layers import nll_loss
+from attacking_federate_learning_tpu.utils.flatten import FlatParams
+
+
+def make_loss_fn(model: Model, flat: FlatParams):
+    """Mean-NLL loss on flat wire-format weights (reference user.py:36,
+    :77-79: log_softmax head + NLLLoss)."""
+
+    def loss_fn(flat_w, x, y):
+        params = flat.unravel(flat_w)
+        return nll_loss(model.apply(params, x), y)
+
+    return loss_fn
+
+
+def make_client_grad_fn(model: Model, flat: FlatParams):
+    """(d,), (n, B, ...), (n, B) -> (n, d) per-client gradients."""
+    grad_fn = jax.grad(make_loss_fn(model, flat))
+
+    def clients_grads(flat_w, xs, ys):
+        return jax.vmap(grad_fn, in_axes=(None, 0, 0))(flat_w, xs, ys)
+
+    return clients_grads

@@ -1,0 +1,231 @@
+"""Experiment engine: the jitted round loop.
+
+The reference's round is four host-side phases over one process
+(reference main.py:64-71): dispatch_weights (N sequential client steps),
+attacker.attack, collect_gradients, defend+update.  Here a round is:
+
+    grads = vmap(grad(loss))(w, batches)      # all clients at once
+    grads = attack.apply(grads, f, ctx)       # first-f-rows overwrite
+    state = momentum_update(state, defense(grads, n, f))
+
+For pure attacks (none / ALIE) the whole round is one jitted function of
+``(state, round_index)`` — batch gathers included — so steady-state rounds
+are a single device program.  The backdoor attack runs its shadow-net
+optimization as its own jitted function between two jitted round halves,
+mirroring the reference's seam (main.py:66-71) without recompiling the round.
+
+Evaluation, checkpointing and logging stay on the host at TEST_STEP cadence
+(reference main.py:73-95).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from attacking_federate_learning_tpu.attacks.base import (
+    Attack, AttackContext, NoAttack
+)
+from attacking_federate_learning_tpu.config import ExperimentConfig
+from attacking_federate_learning_tpu.core.client import make_client_grad_fn
+from attacking_federate_learning_tpu.core.evaluate import make_eval_fn
+from attacking_federate_learning_tpu.core.server import (
+    ServerState, faded_learning_rate, init_server_state, momentum_update
+)
+from attacking_federate_learning_tpu.data.datasets import load_dataset
+from attacking_federate_learning_tpu.data.partition import (
+    make_shards, round_batch_indices
+)
+from attacking_federate_learning_tpu.defenses.kernels import (
+    DEFENSES, check_defense_args
+)
+from attacking_federate_learning_tpu.models.base import get_model
+from attacking_federate_learning_tpu.utils.flatten import make_flattener
+from attacking_federate_learning_tpu.utils.metrics import RunLogger
+
+
+class FederatedExperiment:
+    def __init__(self, cfg: ExperimentConfig, attacker: Optional[Attack] = None,
+                 dataset=None, shardings=None):
+        self.cfg = cfg
+        self.attacker = attacker or NoAttack()
+        self.dataset = dataset or load_dataset(cfg.dataset, cfg.data_dir,
+                                               cfg.seed)
+        self.model = get_model(cfg.model)
+        self.n = cfg.users_count
+        self.f = cfg.corrupted_count
+        check_defense_args(cfg.defense, self.n, self.f)
+        self.defense_fn = DEFENSES[cfg.defense]
+        if cfg.krum_paper_scoring and cfg.defense in ("Krum", "Bulyan"):
+            self.defense_fn = functools.partial(self.defense_fn,
+                                                paper_scoring=True)
+        if shardings is None and cfg.mesh_shape is not None:
+            from attacking_federate_learning_tpu.parallel.mesh import make_plan
+            shardings = make_plan(tuple(cfg.mesh_shape))
+        self.shardings = shardings  # parallel.MeshPlan or None (single device)
+
+        key = jax.random.key(cfg.seed)
+        k_init, self.key_run = jax.random.split(key)
+        params0 = self.model.init(k_init)
+        self.flat = make_flattener(params0)
+        self.state = init_server_state(self.flat.ravel(params0))
+
+        shards = make_shards(cfg.partition, self.dataset.train_y, self.n,
+                             cfg.seed, cfg.dirichlet_alpha)
+        self.shards = jnp.asarray(shards)
+        self.train_x = jnp.asarray(self.dataset.train_x)
+        self.train_y = jnp.asarray(self.dataset.train_y)
+        if shardings is not None:
+            self.shards, self.train_x, self.train_y, self.state = (
+                shardings.place(self.shards, self.train_x, self.train_y,
+                                self.state))
+
+        self._grad_dtype = jnp.dtype(cfg.grad_dtype)
+        self._client_grads = make_client_grad_fn(self.model, self.flat)
+        self._build_round_fns()
+        self.evaluate = make_eval_fn(self.model, self.flat,
+                                     self.dataset.test_x, self.dataset.test_y,
+                                     cfg.batch_size)
+        self.metadata = (self.collect_metadata() if cfg.collect_metadata
+                         else None)
+
+    # ------------------------------------------------------------------
+    def collect_metadata(self):
+        """Metadata subsystem (reference C12, SURVEY.md §2 — vestigial
+        there): every client contributes a stratified ~metadata_fraction
+        sample of its first batch (reference user.py:63-66,
+        train_test_split(test_size=0.11, stratify=y)); the server
+        concatenates them (server.py:62-77).  Returns (meta_x, meta_y) —
+        the validation pool a FLTrust/Zeno-style defense can consume."""
+        import numpy as np
+        cfg = self.cfg
+        shards = np.asarray(self.shards)
+        xs = np.asarray(self.dataset.train_x)
+        ys = np.asarray(self.dataset.train_y)
+        rng = np.random.default_rng(cfg.seed + 42)
+        meta_x, meta_y = [], []
+        for i in range(self.n):
+            batch = shards[i, : cfg.batch_size]
+            labels = ys[batch]
+            take = max(1, int(round(cfg.metadata_fraction * len(batch))))
+            # Stratified: sample each label proportionally.
+            picked = []
+            for c in np.unique(labels):
+                pool = batch[labels == c]
+                k = max(1, int(round(take * len(pool) / len(batch))))
+                picked.extend(rng.choice(pool, size=min(k, len(pool)),
+                                         replace=False).tolist())
+            picked = np.asarray(picked[:take], np.int64)
+            meta_x.append(xs[picked])
+            meta_y.append(ys[picked])
+        return np.concatenate(meta_x), np.concatenate(meta_y)
+
+    def get_metadata(self):
+        """Reference server.get_MetaData (server.py:58-59)."""
+        return self.metadata
+
+    # ------------------------------------------------------------------
+    def _gather_batches(self, t):
+        """Round-t minibatch for every client: one (n, B) gather
+        (replaces the reference's N host-side DataLoaders, user.py:52-55)."""
+        idx = round_batch_indices(self.shards, t, self.cfg.batch_size)
+        return self.train_x[idx], self.train_y[idx]
+
+    def _compute_grads_impl(self, state: ServerState, t):
+        xs, ys = self._gather_batches(t)
+        grads = self._client_grads(state.weights, xs, ys)
+        grads = grads.astype(self._grad_dtype)  # bf16 halves HBM at scale
+        if self.shardings is not None:
+            grads = self.shardings.constrain_grads(grads)
+        return grads
+
+    def _aggregate_impl(self, state: ServerState, grads, t):
+        agg = self.defense_fn(grads, self.n, self.f).astype(jnp.float32)
+        if self.cfg.server_uses_faded_lr:
+            lr = faded_learning_rate(self.cfg.learning_rate,
+                                     self.cfg.fading_rate, t)
+        else:
+            # Reference parity: constant base lr on the server
+            # (server.py:89, SURVEY.md §2.4 #7).
+            lr = self.cfg.learning_rate
+        return momentum_update(state, agg, lr, self.cfg.momentum)
+
+    def _build_round_fns(self):
+        cfg = self.cfg
+
+        def ctx_for(state, t):
+            return AttackContext(
+                original_params=state.weights,
+                learning_rate=faded_learning_rate(
+                    cfg.learning_rate, cfg.fading_rate, t))
+
+        if getattr(self.attacker, "fusable", True):
+            def fused(state, t):
+                grads = self._compute_grads_impl(state, t)
+                grads = self.attacker.apply(grads, self.f, ctx_for(state, t))
+                return self._aggregate_impl(state, grads, t)
+
+            self._fused_round = jax.jit(fused, donate_argnums=0)
+            self._staged = False
+        else:
+            self._compute_grads = jax.jit(self._compute_grads_impl)
+            self._aggregate = jax.jit(self._aggregate_impl, donate_argnums=0)
+            self._staged = True
+
+    # ------------------------------------------------------------------
+    def run_round(self, t: int) -> ServerState:
+        t = jnp.asarray(t, jnp.int32)
+        if not self._staged:
+            self.state = self._fused_round(self.state, t)
+        else:
+            grads = self._compute_grads(self.state, t)
+            ctx = AttackContext(
+                original_params=self.state.weights,
+                learning_rate=faded_learning_rate(
+                    self.cfg.learning_rate, self.cfg.fading_rate, t))
+            grads = self.attacker.apply(grads, self.f, ctx)
+            self.state = self._aggregate(self.state, grads, t)
+        return self.state
+
+    def run(self, logger: Optional[RunLogger] = None,
+            checkpointer=None) -> dict:
+        """Full experiment loop (reference main.py:64-95)."""
+        cfg = self.cfg
+        logger = logger or RunLogger(cfg, cfg.output, cfg.log_dir)
+        test_size = len(self.dataset.test_y)
+
+        if cfg.backdoor:
+            # Pre-training accuracy line (reference main.py:45-51).
+            loss0, correct0 = self.evaluate(self.state.weights)
+            logger.print(
+                "\nBEFORE: Test set. Average loss: {:.4f}, Accuracy: {}/{} "
+                "({:.2f}%)".format(float(loss0), int(correct0), test_size,
+                                   100.0 * float(correct0) / test_size))
+        else:
+            logger.print("\nStarting Training...")
+
+        for epoch in range(cfg.epochs):
+            self.run_round(epoch)
+
+            if epoch % cfg.test_step == 0 or epoch == cfg.epochs - 1:
+                test_loss, correct = self.evaluate(self.state.weights)
+                accuracy = logger.record_eval(epoch, test_loss, correct,
+                                              test_size)
+                if (accuracy > cfg.checkpoint_acc_threshold
+                        and checkpointer is not None):
+                    checkpointer.save(self.state, accuracy)
+                if cfg.backdoor and hasattr(self.attacker, "test_asr"):
+                    # Post-aggregation backdoor check, printed after the
+                    # accuracy line as in the reference (main.py:91-95).
+                    asr = self.attacker.test_asr(self.state.weights,
+                                                 logger=logger, tag="POST")
+                    logger.record(kind="asr", round=epoch,
+                                  attack_success_rate=float(asr))
+
+        logger.finish()
+        return {"accuracies": logger.accuracies,
+                "epochs": logger.accuracies_epochs,
+                "final_weights": self.state.weights}

@@ -1,0 +1,48 @@
+"""Server state and the aggregation update.
+
+The reference server's global state is three arrays: the flat weight vector,
+the (n, d) gradient matrix and the momentum velocity (reference
+server.py:34-36), updated by ``v = mu*v - lr*g; w += v`` on the *constant*
+base learning rate (server.py:89-90 — the faded lr reaches only the clients,
+SURVEY.md §2.4 #7).  Here that state is an immutable NamedTuple and the
+update is a pure function; the (n, d) matrix is never stored on the state —
+it flows through the round function.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ServerState(NamedTuple):
+    weights: jax.Array    # (d,) flat wire-format weights
+    velocity: jax.Array   # (d,) momentum buffer
+    round: jax.Array      # () int32
+
+
+def init_server_state(flat_weights) -> ServerState:
+    return ServerState(
+        weights=flat_weights,
+        velocity=jnp.zeros_like(flat_weights),
+        round=jnp.zeros((), jnp.int32),
+    )
+
+
+def momentum_update(state: ServerState, agg_grad, learning_rate,
+                    momentum) -> ServerState:
+    """Momentum-SGD step on the aggregated gradient (reference
+    server.py:89-90)."""
+    velocity = momentum * state.velocity - learning_rate * agg_grad
+    return ServerState(
+        weights=state.weights + velocity,
+        velocity=velocity,
+        round=state.round + 1,
+    )
+
+
+def faded_learning_rate(base_lr, fading_rate, epoch):
+    """Hyperbolic LR fading (reference server.py:50-52)."""
+    return base_lr * fading_rate / (epoch + fading_rate)

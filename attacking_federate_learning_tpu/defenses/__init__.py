@@ -1,0 +1,3 @@
+from attacking_federate_learning_tpu.defenses.kernels import (  # noqa: F401
+    DEFENSES, bulyan, check_defense_args, krum, no_defense, trimmed_mean
+)

@@ -1,0 +1,27 @@
+"""Model abstraction: a pair of pure functions plus shape metadata.
+
+A model is ``init(key) -> params`` and ``apply(params, x) -> log_probs``.
+Params are ordered dicts in torch ``.parameters()`` order so the flat wire
+vector (utils/flatten.py) matches the reference's byte layout.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Tuple
+
+from attacking_federate_learning_tpu.utils.registry import Registry
+
+
+class Model(NamedTuple):
+    name: str
+    init: Callable            # (key) -> params pytree
+    apply: Callable           # (params, x) -> (batch, classes) log-probs
+    input_shape: Tuple[int, ...]   # per-example, e.g. (784,) or (3, 32, 32)
+    num_classes: int
+
+
+MODELS = Registry("model")
+
+
+def get_model(name: str) -> Model:
+    return MODELS[name]()

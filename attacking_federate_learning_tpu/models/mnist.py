@@ -1,0 +1,40 @@
+"""MNIST MLP: 784 -> 100 -> 10, log-softmax head.
+
+Reproduces reference ``MnistNet`` (data_sets.py:13-30): fc1 xavier-uniform
+weight (data_sets.py:17), fc2 torch-default init, ReLU between, inputs
+flattened to 784 by the caller (reference user.py:71, server.py:101).
+Parameter order fc1.weight, fc1.bias, fc2.weight, fc2.bias — d = 79,510.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax
+
+from attacking_federate_learning_tpu.models import layers as L
+from attacking_federate_learning_tpu.models.base import MODELS, Model
+
+
+def _init(key):
+    k1, k2 = jax.random.split(key)
+    # OrderedDict in torch .parameters() definition order (wire format).
+    return OrderedDict([
+        ("fc1", L.linear_init(k1, 28 * 28, 100, xavier=True)),
+        ("fc2", L.linear_init(k2, 100, 10)),
+    ])
+
+
+def _apply(params, x):
+    # Accepts (B, 784) or image-shaped input; flattening mirrors the
+    # reference's data.view(-1, 28*28) at the call sites.
+    x = x.reshape((x.shape[0], -1))
+    x = jax.nn.relu(L.linear(params["fc1"], x))
+    x = L.linear(params["fc2"], x)
+    return L.log_softmax(x)
+
+
+@MODELS.register("mnist_mlp")
+def mnist_mlp() -> Model:
+    return Model(name="mnist_mlp", init=_init, apply=_apply,
+                 input_shape=(784,), num_classes=10)

@@ -1,0 +1,3 @@
+from attacking_federate_learning_tpu.ops.distances import (  # noqa: F401
+    pairwise_distances, pairwise_sq_distances
+)

@@ -1,0 +1,2 @@
+# Device-mesh / sharding layer (no reference analog: the reference has no
+# distributed backend, SURVEY.md §2.3).  Populated by parallel/mesh.py.

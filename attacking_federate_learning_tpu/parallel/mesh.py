@@ -1,0 +1,87 @@
+"""Device mesh and sharding layout for the client axis.
+
+The reference has no distributed backend at all — "broadcast" is a Python
+loop handing one numpy array to N objects and "gather" is a row-copy into a
+preallocated matrix (reference server.py:54-56, :81-83; SURVEY.md §2.3).
+The TPU-native equivalent is a ``jax.sharding.Mesh`` with axes
+
+    ('clients', 'model')
+
+where the (n, d) gradient matrix is sharded ('clients', 'model'), client
+batches are sharded along 'clients', and the flat weight/velocity vectors
+are sharded along 'model' (replicated when the model axis is 1).  Broadcast
+is then free (XLA replicates as needed over ICI) and every defense collective
+(Gram matmul, sorts, psum) is inserted by the compiler from these
+annotations.  Multi-host spanning over DCN falls out of
+``jax.distributed.initialize`` + a global mesh; there is no transport code
+to write.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CLIENTS = "clients"
+MODEL = "model"
+
+
+def make_mesh(mesh_shape: Optional[tuple] = None,
+              devices=None) -> Mesh:
+    """Mesh over all (or the given) devices.
+
+    ``mesh_shape=(c, m)`` splits devices between the client axis and the
+    model (d-sharding) axis; default puts every device on the client axis.
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = devices.size
+    if mesh_shape is None:
+        mesh_shape = (n, 1)
+    c, m = mesh_shape
+    if c * m != n:
+        raise ValueError(f"mesh_shape {mesh_shape} != {n} devices")
+    return Mesh(devices.reshape(c, m), (CLIENTS, MODEL))
+
+
+class MeshPlan(NamedTuple):
+    """Placement/annotation bundle consumed by the engine."""
+    mesh: Mesh
+
+    @property
+    def grads_spec(self):
+        return P(CLIENTS, MODEL)
+
+    @property
+    def weights_spec(self):
+        return P(MODEL)
+
+    def sharding(self, spec):
+        return NamedSharding(self.mesh, spec)
+
+    def place(self, shards, train_x, train_y, state):
+        """Initial placement: client-index matrix sharded over clients,
+        dataset replicated (MNIST/CIFAR fit in HBM; per-device dataset
+        sharding is a host-streaming concern, SURVEY.md §7.3 #5), server
+        state sharded over the model axis."""
+        shards = jax.device_put(shards, self.sharding(P(CLIENTS, None)))
+        train_x = jax.device_put(train_x, self.sharding(P()))
+        train_y = jax.device_put(train_y, self.sharding(P()))
+        # Rank-aware: vectors (weights, velocity) shard over the model axis,
+        # scalars (round counter) replicate.
+        state = jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(
+                leaf, self.sharding(self.weights_spec if leaf.ndim >= 1
+                                    else P())),
+            state)
+        return shards, train_x, train_y, state
+
+    def constrain_grads(self, grads):
+        return jax.lax.with_sharding_constraint(
+            grads, self.sharding(self.grads_spec))
+
+
+def make_plan(mesh_shape=None, devices=None) -> MeshPlan:
+    return MeshPlan(mesh=make_mesh(mesh_shape, devices))

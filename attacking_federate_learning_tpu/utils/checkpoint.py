@@ -1,0 +1,73 @@
+"""Checkpoint / resume.
+
+The reference is save-only: ``torch.save({'epoch','state_dict','acc'})`` to
+``runs/<dataset>/checkpoint.pth.tar`` whenever accuracy exceeds 70%, always
+overwriting, and the momentum velocity is not saved so even a hand-written
+resume would be inexact (reference server.py:40-48, main.py:84-89;
+SURVEY.md §5).  This module checkpoints the *complete* server state —
+weights, velocity, round — plus accuracy and the config, and restores it
+exactly: ``resume()`` returns a ServerState that continues the run
+bit-for-bit (tests/test_checkpoint.py::test_resume_continues_bit_for_bit).
+
+Format: a single .npz + a JSON sidecar, portable and dependency-free; the
+flat weight vector inside is wire-format compatible with the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from attacking_federate_learning_tpu.core.server import ServerState
+
+
+class Checkpointer:
+    def __init__(self, cfg, run_dir: Optional[str] = None,
+                 keep_best: bool = True):
+        # Directory schema mirrors the reference: runs/<dataset>/
+        # (server.py:42).
+        self.dir = run_dir or os.path.join(cfg.run_dir, cfg.dataset)
+        os.makedirs(self.dir, exist_ok=True)
+        self.cfg = cfg
+        self.keep_best = keep_best
+        self.best_acc = -1.0
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.dir, "checkpoint.npz")
+
+    def save(self, state: ServerState, accuracy: float, tag: str = None):
+        if self.keep_best and tag is None and accuracy < self.best_acc:
+            # Don't let a later, worse state overwrite the best checkpoint
+            # (the reference always overwrites, server.py:40-48).
+            return self.path
+        path = (os.path.join(self.dir, f"checkpoint-{tag}.npz")
+                if tag else self.path)
+        np.savez(path,
+                 weights=np.asarray(state.weights),
+                 velocity=np.asarray(state.velocity),
+                 round=np.asarray(state.round),
+                 accuracy=np.float32(accuracy))
+        with open(path.replace(".npz", ".json"), "w") as f:
+            json.dump({"accuracy": float(accuracy),
+                       "round": int(state.round),
+                       "config": dataclasses.asdict(self.cfg)}, f, indent=1,
+                      default=str)
+        if self.keep_best and accuracy > self.best_acc:
+            self.best_acc = accuracy
+        return path
+
+    def resume(self, path: Optional[str] = None) -> ServerState:
+        path = path or self.path
+        z = np.load(path)
+        return ServerState(weights=jnp.asarray(z["weights"]),
+                           velocity=jnp.asarray(z["velocity"]),
+                           round=jnp.asarray(z["round"]))
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)

@@ -1,0 +1,48 @@
+"""The wire format: params pytree <-> flat vector.
+
+The reference's load-bearing abstraction is a flat float vector of all model
+parameters (``flatten_params`` reference user.py:17-18, ``row_into_parameters``
+user.py:21-28): server state, the (n_users, d) gradient matrix, defense inputs
+and attack perturbations all live in that format.
+
+Here the pytree is the primary representation (models run on pytrees) and the
+flat vector appears only at the defense/attack boundary, via a pair of jitted
+bijections built once per model with ``jax.flatten_util.ravel_pytree``.
+Because model pytrees are ordered dicts in torch ``.parameters()`` order and
+weights keep torch's (out, in) / (O, I, H, W) layouts, the flat vector is
+bit-layout-compatible with the reference's wire format: a flat vector produced
+by the reference loads into these models unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+
+
+class FlatParams(NamedTuple):
+    """Bijection between a model's params pytree and the flat wire vector."""
+    ravel: Callable[[Any], jax.Array]     # pytree -> (d,)
+    unravel: Callable[[jax.Array], Any]   # (d,) -> pytree
+    dim: int                              # d
+
+
+def make_flattener(example_params) -> FlatParams:
+    flat, unravel = jax.flatten_util.ravel_pytree(example_params)
+
+    def ravel(tree):
+        return jax.flatten_util.ravel_pytree(tree)[0]
+
+    return FlatParams(ravel=ravel, unravel=unravel, dim=int(flat.shape[0]))
+
+
+def ravel_batch(trees) -> jax.Array:
+    """Stacked pytrees (leading client axis) -> (n, d) matrix."""
+    return jax.vmap(lambda t: jax.flatten_util.ravel_pytree(t)[0])(trees)
+
+
+def tree_zeros_like(tree):
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)

@@ -1,0 +1,80 @@
+"""Structured run metrics and logging.
+
+The reference logs via a print/file tee closure (reference main.py:13-18), a
+``locals()`` config dump (main.py:19), accuracy lines every TEST_STEP rounds
+(main.py:77-80) and a CSV of the accuracy trajectory whose filename encodes
+every hyperparameter (main.py:100).  This module keeps all of those outputs
+(tee, config dump, CSV with the same filename schema) and adds what the
+reference lacks (SURVEY.md §5): structured per-round JSONL records with
+round, lr, clean accuracy, loss, attack-success rate and wall-clock phase
+timings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+
+class RunLogger:
+    def __init__(self, config, output: Optional[str] = None,
+                 log_dir: str = "logs", jsonl_name: Optional[str] = None):
+        self.config = config
+        self.output = output
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)  # the reference crashes when
+        # logs/ is missing (main.py:100, readme.md:25); we create it.
+        base = jsonl_name or config.csv_name().replace(".csv", "")
+        self.jsonl_path = os.path.join(log_dir, base + ".jsonl")
+        self._jsonl = open(self.jsonl_path, "a")
+        self.accuracies: list = []
+        self.accuracies_epochs: list = []
+        self._t0 = time.time()
+
+    # --- reference-style tee (main.py:13-18) ---------------------------
+    def print(self, s, end="\n"):
+        if self.output:
+            with open(self.output, "a+") as f:
+                f.write(str(s) + end)
+        else:
+            print(s, end=end, flush=True)
+
+    def dump_config(self):
+        self.print(dataclasses.asdict(self.config))
+
+    # --- structured records --------------------------------------------
+    def record(self, **fields):
+        fields.setdefault("t", round(time.time() - self._t0, 3))
+        self._jsonl.write(json.dumps(fields, default=float) + "\n")
+        self._jsonl.flush()
+
+    def record_eval(self, epoch, test_loss, correct, test_size, asr=None,
+                    **extra):
+        accuracy = 100.0 * float(correct) / test_size
+        self.accuracies.append(accuracy)
+        self.accuracies_epochs.append(epoch)
+        # Line format mirrors reference main.py:77-80.
+        self.print("Test set: [{:3d}] Average loss: {:.4f}, "
+                   "Accuracy: {}/{} ({:.2f}%)".format(
+                       epoch, float(test_loss), int(correct), test_size,
+                       accuracy))
+        rec = dict(kind="eval", round=epoch, test_loss=float(test_loss),
+                   accuracy=accuracy, correct=int(correct),
+                   test_size=test_size, **extra)
+        if asr is not None:
+            rec["attack_success_rate"] = float(asr)
+        self.record(**rec)
+        return accuracy
+
+    def finish(self):
+        if self.accuracies:
+            self.print("Max accuracy: {}".format(max(self.accuracies)))
+            # CSV with the reference's filename schema (main.py:100).
+            np.savetxt(os.path.join(self.log_dir, self.config.csv_name()),
+                       np.asarray(self.accuracies), delimiter=",")
+        self._jsonl.close()

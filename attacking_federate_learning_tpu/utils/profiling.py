@@ -1,0 +1,57 @@
+"""Tracing / profiling hooks.
+
+The reference's only timing artifact is a wall-clock timestamp printed at run
+end (reference main.py:97; SURVEY.md §5 "tracing: absent").  Here every round
+phase (grads / attack / aggregate / eval) can be timed with a context-manager
+stopwatch that blocks on device completion, and a full XLA trace can be
+captured with ``jax.profiler`` around any region for TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Optional
+
+import jax
+
+
+class PhaseTimer:
+    """Accumulates per-phase wall-clock, device-synchronized."""
+
+    def __init__(self):
+        self.totals = defaultdict(float)
+        self.counts = defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str, sync_on=None):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync_on is not None:
+                jax.block_until_ready(sync_on)
+            dt = time.perf_counter() - t0
+            self.totals[name] += dt
+            self.counts[name] += 1
+
+    def summary(self) -> dict:
+        return {name: {"total_s": round(self.totals[name], 4),
+                       "count": self.counts[name],
+                       "mean_ms": round(1e3 * self.totals[name]
+                                        / max(self.counts[name], 1), 3)}
+                for name in self.totals}
+
+
+@contextlib.contextmanager
+def xla_trace(log_dir: Optional[str]):
+    """Capture a jax.profiler trace if log_dir is given, else no-op."""
+    if not log_dir:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,15 @@
+"""Test harness: 8 virtual CPU devices so every shard_map / pjit path runs
+in CI without a TPU (SURVEY.md §4(e)).  Must run before jax initializes."""
+
+import os
+
+# Force CPU and disable the axon TPU site hook: on this image a
+# sitecustomize.py dials the (single-client) TPU relay at interpreter start,
+# which serializes/hangs concurrent test runs.  Clearing PALLAS_AXON_POOL_IPS
+# makes the hook a no-op; tests are CPU-only by design.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

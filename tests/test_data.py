@@ -1,0 +1,75 @@
+"""Data layer: partitioners, batch cycling, synthetic datasets, triggers."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from attacking_federate_learning_tpu import config as C
+from attacking_federate_learning_tpu.data import partition as P
+from attacking_federate_learning_tpu.data import triggers
+from attacking_federate_learning_tpu.data.datasets import load_dataset
+
+
+def test_iid_shards_cover_and_balance():
+    shards = P.iid_shards(103, 10, seed=0)
+    assert shards.shape == (10, 11)  # ceil(103/10), padded by wrapping
+    # Every example appears at least once (DistributedSampler semantics,
+    # reference user.py:49-54).
+    assert set(shards.ravel().tolist()) == set(range(103))
+
+
+def test_iid_shards_disjoint_before_padding():
+    shards = P.iid_shards(100, 10, seed=1)
+    flat = shards.ravel()
+    assert len(set(flat.tolist())) == 100  # exact partition when divisible
+
+
+def test_round_batches_cycle():
+    shards = P.iid_shards(40, 4, seed=2)  # shard_len 10
+    b0 = np.asarray(P.round_batch_indices(jnp.asarray(shards), 0, 4))
+    b_wrap = np.asarray(P.round_batch_indices(jnp.asarray(shards), 3, 4))
+    assert b0.shape == (4, 4)
+    # Round 3 offset 12 -> wraps to positions [2,3,4,5] of each shard.
+    np.testing.assert_array_equal(b_wrap, shards[:, [2, 3, 4, 5]])
+
+
+def test_dirichlet_shards_shape_and_skew():
+    labels = np.random.default_rng(0).integers(0, 10, 5000).astype(np.int32)
+    shards = P.dirichlet_shards(labels, 8, alpha=0.1, seed=3)
+    assert shards.shape[0] == 8
+    # Strong alpha=0.1 skew: some client's label histogram is dominated by
+    # few classes.
+    hist = np.bincount(labels[shards[0]], minlength=10)
+    assert hist.max() > hist.sum() * 0.25
+
+
+def test_synthetic_dataset_properties():
+    ds = load_dataset(C.SYNTH_MNIST, seed=0, synth_train=512, synth_test=128)
+    assert ds.train_x.shape == (512, 1, 28, 28)
+    assert ds.train_y.shape == (512,)
+    assert ds.num_classes == 10
+    # Deterministic across loads.
+    ds2 = load_dataset(C.SYNTH_MNIST, seed=0, synth_train=512, synth_test=128)
+    np.testing.assert_array_equal(ds.train_x, ds2.train_x)
+
+
+def test_mnist_falls_back_to_synthetic_when_files_absent():
+    ds = load_dataset(C.MNIST, data_dir="/nonexistent", seed=0,
+                      synth_train=64, synth_test=32)
+    assert ds.name == C.SYNTH_MNIST
+
+
+def test_pattern_trigger():
+    x = jnp.zeros((3, 1, 28, 28))
+    t = np.asarray(triggers.add_pattern(x))
+    # 5x5 corner at 2.8 post-normalization (reference backdoor.py:47-50).
+    assert (t[:, :, :5, :5] == 2.8).all()
+    assert (t[:, :, 5:, :] == 0).all() and (t[:, :, :, 5:] == 0).all()
+
+
+def test_backdoor_targets():
+    y = jnp.asarray([0, 1, 4, 7, 9])
+    np.testing.assert_array_equal(
+        np.asarray(triggers.backdoor_targets(y, "pattern")), 0)
+    np.testing.assert_array_equal(
+        np.asarray(triggers.backdoor_targets(y, 2)),
+        np.asarray([1, 2, 0, 3, 0]))  # (y+1)%5, reference backdoor.py:83

@@ -1,0 +1,86 @@
+"""Model zoo: shapes, wire-format dimensions, forward semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attacking_federate_learning_tpu.models import get_model
+from attacking_federate_learning_tpu.utils.flatten import make_flattener
+
+
+# Wire dims must match the reference nets parameter-for-parameter
+# (reference data_sets.py:13-30 MnistNet, :33-61 Cifar10Net).
+EXPECTED_DIMS = {
+    "mnist_mlp": 784 * 100 + 100 + 100 * 10 + 10,               # 79,510
+    "cifar10_cnn": (16 * 3 * 9 + 16) + (64 * 16 * 16 + 64)
+                   + (384 * 64 + 384) + (192 * 384 + 192)
+                   + (10 * 192 + 10),
+}
+
+
+@pytest.mark.parametrize("name", list(EXPECTED_DIMS))
+def test_wire_dim(name):
+    model = get_model(name)
+    params = model.init(jax.random.key(0))
+    flat = make_flattener(params)
+    assert flat.dim == EXPECTED_DIMS[name]
+
+
+@pytest.mark.parametrize("name", list(EXPECTED_DIMS))
+def test_forward_is_log_softmax(name):
+    model = get_model(name)
+    params = model.init(jax.random.key(1))
+    x = jax.random.normal(jax.random.key(2), (4,) + model.input_shape)
+    out = model.apply(params, x)
+    assert out.shape == (4, model.num_classes)
+    # log-probs sum to 1 in prob space (log_softmax head,
+    # reference data_sets.py:23, :51)
+    np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), 1.0,
+                               atol=1e-5)
+
+
+def test_flatten_roundtrip():
+    model = get_model("mnist_mlp")
+    params = model.init(jax.random.key(3))
+    flat = make_flattener(params)
+    v = flat.ravel(params)
+    back = flat.unravel(v)
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_wire_order_matches_torch_parameters():
+    """The flat vector must be fc1.w, fc1.b, fc2.w, fc2.b in torch layouts
+    so reference-produced vectors load unchanged."""
+    model = get_model("mnist_mlp")
+    params = model.init(jax.random.key(4))
+    flat = make_flattener(params)
+    v = np.asarray(flat.ravel(params))
+    w1 = np.asarray(params["fc1"]["weight"]).ravel()
+    np.testing.assert_array_equal(v[: w1.size], w1)
+    b1 = np.asarray(params["fc1"]["bias"])
+    np.testing.assert_array_equal(v[w1.size: w1.size + b1.size], b1)
+
+
+def test_mnist_init_distributions():
+    """fc1 xavier (reference data_sets.py:17), fc2 torch-default bounds."""
+    model = get_model("mnist_mlp")
+    params = model.init(jax.random.key(5))
+    w1 = np.asarray(params["fc1"]["weight"])
+    bound1 = np.sqrt(6.0 / (784 + 100))
+    assert np.abs(w1).max() <= bound1 + 1e-6
+    assert np.abs(w1).max() > 0.8 * bound1   # actually fills the range
+    w2 = np.asarray(params["fc2"]["weight"])
+    assert np.abs(w2).max() <= 0.1 + 1e-6    # 1/sqrt(100)
+
+
+def test_cifar10_spatial_trace():
+    """32 -conv3-> 30 -pool3-> 10 -conv4-> 7 -pool4-> 1 (reference
+    data_sets.py:36-43)."""
+    model = get_model("cifar10_cnn")
+    params = model.init(jax.random.key(6))
+    x = jnp.zeros((2, 3, 32, 32))
+    out = model.apply(params, x)   # would shape-error if the trace differed
+    assert out.shape == (2, 10)
