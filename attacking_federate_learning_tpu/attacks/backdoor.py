@@ -37,6 +37,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from attacking_federate_learning_tpu.attacks.base import Attack, cohort_stats
+from attacking_federate_learning_tpu.core.evaluate import (
+    masked_nll_metrics, pad_to_batches
+)
 from attacking_federate_learning_tpu.data import triggers
 from attacking_federate_learning_tpu.models.base import get_model
 from attacking_federate_learning_tpu.models.layers import nll_loss
@@ -80,17 +83,14 @@ class BackdoorAttack(Attack):
             py = jnp.asarray(y[k: k + 1])
         py = triggers.backdoor_targets(py, self.backdoor)
 
-        # Pad to whole batches with a validity mask (static shapes).
+        # Pad to whole batches with a validity mask (static shapes; shared
+        # helper with the server eval path).
         n = px.shape[0]
-        nb = -(-n // B) if n >= B else 1
-        B = min(B, n) if n < B else B
-        pad = nb * B - n
-        mask = jnp.concatenate([jnp.ones((n,)), jnp.zeros((pad,))])
-        px = jnp.concatenate([px, jnp.zeros((pad,) + px.shape[1:], px.dtype)])
-        py = jnp.concatenate([py, jnp.zeros((pad,), py.dtype)])
-        self.poison_x = px.reshape((nb, B) + px.shape[1:])
-        self.poison_y = py.reshape((nb, B))
-        self.poison_mask = mask.reshape((nb, B))
+        bx, by, bm = pad_to_batches(np.asarray(px), np.asarray(py),
+                                    min(B, n))
+        self.poison_x = jnp.asarray(bx)
+        self.poison_y = jnp.asarray(by)
+        self.poison_mask = jnp.asarray(bm)
         self.poison_count = float(n)
 
     # ------------------------------------------------------------------
@@ -107,19 +107,8 @@ class BackdoorAttack(Attack):
             backdoor.py:43; loss is the sum of per-batch mean NLLs divided
             by the set size, matching backdoor.py:89, :93)."""
             params = flat.unravel(flat_w)
-
-            def batch_metrics(carry, batch):
-                x, y, m = batch
-                logp = model.apply(params, x)
-                per_ex = -jnp.take_along_axis(
-                    logp, y[:, None], axis=1).squeeze(1)
-                batch_mean = (jnp.sum(per_ex * m)
-                              / jnp.maximum(jnp.sum(m), 1.0))
-                correct = jnp.sum((jnp.argmax(logp, axis=1) == y) * m)
-                return (carry[0] + batch_mean, carry[1] + correct), None
-
-            (loss_sum, correct), _ = jax.lax.scan(
-                batch_metrics, (jnp.zeros(()), jnp.zeros(())), (px, py, pm))
+            loss_sum, correct = masked_nll_metrics(model.apply, params,
+                                                   px, py, pm)
             return loss_sum / self.poison_count, correct
 
         def poison_accuracy(flat_w):

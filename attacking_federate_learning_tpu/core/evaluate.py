@@ -33,6 +33,26 @@ def pad_to_batches(x, y, batch_size):
             mask.reshape(shape))
 
 
+def masked_nll_metrics(apply_fn, params, bx, by, bm):
+    """Scan batched (nb, B, ...) data: returns (sum of per-batch masked-mean
+    NLLs, masked correct count) — the reference's exact eval arithmetic
+    (server.py:104-110), shared by server eval and the backdoor ASR check
+    (backdoor.py:89-94)."""
+
+    def batch_metrics(carry, batch):
+        x, y, m = batch
+        logp = apply_fn(params, x)
+        per_ex = -jnp.take_along_axis(logp, y[:, None], axis=1).squeeze(1)
+        batch_mean = jnp.sum(per_ex * m) / jnp.maximum(jnp.sum(m), 1.0)
+        correct = jnp.sum((jnp.argmax(logp, axis=1) == y) * m)
+        loss_sum, correct_sum = carry
+        return (loss_sum + batch_mean, correct_sum + correct), None
+
+    (loss_sum, correct_sum), _ = jax.lax.scan(
+        batch_metrics, (jnp.zeros(()), jnp.zeros(())), (bx, by, bm))
+    return loss_sum, correct_sum
+
+
 def make_eval_fn(model: Model, flat: FlatParams, test_x, test_y, batch_size):
     """Returns jitted (flat_w) -> (test_loss, correct) on the full test set."""
     bx, by, bm = (jnp.asarray(a)
@@ -42,18 +62,8 @@ def make_eval_fn(model: Model, flat: FlatParams, test_x, test_y, batch_size):
     @functools.partial(jax.jit, donate_argnums=())
     def evaluate(flat_w):
         params = flat.unravel(flat_w)
-
-        def batch_metrics(carry, batch):
-            x, y, m = batch
-            logp = model.apply(params, x)
-            per_ex = -jnp.take_along_axis(logp, y[:, None], axis=1).squeeze(1)
-            batch_mean = jnp.sum(per_ex * m) / jnp.maximum(jnp.sum(m), 1.0)
-            correct = jnp.sum((jnp.argmax(logp, axis=1) == y) * m)
-            loss_sum, correct_sum = carry
-            return (loss_sum + batch_mean, correct_sum + correct), None
-
-        (loss_sum, correct_sum), _ = jax.lax.scan(
-            batch_metrics, (jnp.zeros(()), jnp.zeros(())), (bx, by, bm))
+        loss_sum, correct_sum = masked_nll_metrics(model.apply, params,
+                                                   bx, by, bm)
         return loss_sum / n_test, correct_sum
 
     return evaluate

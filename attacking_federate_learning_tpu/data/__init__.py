@@ -1,0 +1,7 @@
+from attacking_federate_learning_tpu.data.datasets import (  # noqa: F401
+    Dataset, load_dataset
+)
+from attacking_federate_learning_tpu.data.partition import (  # noqa: F401
+    make_shards, round_batch_indices
+)
+from attacking_federate_learning_tpu.data import triggers  # noqa: F401

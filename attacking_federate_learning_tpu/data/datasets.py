@@ -1,0 +1,188 @@
+"""Datasets as device-residable arrays.
+
+The reference streams data through torchvision ``DataLoader``s with one
+DataLoader per client (reference user.py:46-55) — a host-side Python iterator
+per client, which is exactly what serializes its round loop.  Here a dataset
+is a pair of dense arrays (images normalized up-front, labels int32) that
+lives in HBM; clients are rows of an index matrix and a "batch" is one
+gather.  MNIST/CIFAR fit comfortably in HBM (MNIST train = 179 MB f32).
+
+Loaders read the raw distribution files directly (MNIST IDX, CIFAR-10/100
+python pickles) — no torchvision dependency.  When raw files are absent
+(e.g. an air-gapped machine) the SYNTH_* datasets provide deterministic,
+learnable class-structured data with identical shapes and normalization, so
+every code path (training, triggers, defenses) exercises the same math.
+
+Normalization matches the reference transforms: MNIST (x-0.1307)/0.3081
+(reference data_sets.py:26-27), CIFAR10 (x-0.5)/0.5 (data_sets.py:56-57),
+CIFAR100 per-channel stats (data_sets.py:154-155).  Backdoor triggers are
+applied *after* normalization, as in the reference (data_sets.py:26-30
+appends the trigger transform after Normalize; backdoor.py:49).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from attacking_federate_learning_tpu import config as C
+
+
+class Dataset(NamedTuple):
+    name: str
+    train_x: np.ndarray   # (N, ...) normalized float32
+    train_y: np.ndarray   # (N,) int32
+    test_x: np.ndarray
+    test_y: np.ndarray
+    num_classes: int
+
+
+MNIST_MEAN, MNIST_STD = 0.1307, 0.3081
+CIFAR10_MEAN, CIFAR10_STD = 0.5, 0.5
+CIFAR100_MEAN = np.array([125.3, 123.0, 113.9], np.float32) / 255.0
+CIFAR100_STD = np.array([63.0, 62.1, 66.7], np.float32) / 255.0
+
+
+# --------------------------------------------------------------------------
+# raw-file loaders
+# --------------------------------------------------------------------------
+
+def _open_maybe_gz(path):
+    if os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rb")
+    return open(path, "rb")
+
+
+def _read_idx(path) -> np.ndarray:
+    with _open_maybe_gz(path) as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(dims)
+
+
+def load_mnist(data_dir: str) -> Dataset:
+    d = os.path.join(data_dir, "MNIST", "raw")
+    if not os.path.isdir(d):
+        d = data_dir
+    tx = _read_idx(os.path.join(d, "train-images-idx3-ubyte"))
+    ty = _read_idx(os.path.join(d, "train-labels-idx1-ubyte"))
+    vx = _read_idx(os.path.join(d, "t10k-images-idx3-ubyte"))
+    vy = _read_idx(os.path.join(d, "t10k-labels-idx1-ubyte"))
+
+    def norm(x):
+        x = x.astype(np.float32) / 255.0
+        return ((x - MNIST_MEAN) / MNIST_STD)[:, None, :, :]  # (N,1,28,28)
+
+    return Dataset("MNIST", norm(tx), ty.astype(np.int32),
+                   norm(vx), vy.astype(np.int32), 10)
+
+
+def _load_cifar_pickles(paths, key_x=b"data", key_y=b"labels"):
+    xs, ys = [], []
+    for p in paths:
+        with open(p, "rb") as f:
+            batch = pickle.load(f, encoding="bytes")
+        xs.append(batch[key_x])
+        ys.extend(batch[key_y])
+    x = np.concatenate(xs).reshape(-1, 3, 32, 32)
+    return x, np.asarray(ys, np.int32)
+
+
+def load_cifar10(data_dir: str) -> Dataset:
+    d = os.path.join(data_dir, "cifar-10-batches-py")
+    if not os.path.isdir(d):
+        d = data_dir
+    tx, ty = _load_cifar_pickles(
+        [os.path.join(d, f"data_batch_{i}") for i in range(1, 6)])
+    vx, vy = _load_cifar_pickles([os.path.join(d, "test_batch")])
+
+    def norm(x):
+        return (x.astype(np.float32) / 255.0 - CIFAR10_MEAN) / CIFAR10_STD
+
+    return Dataset("CIFAR10", norm(tx), ty, norm(vx), vy, 10)
+
+
+def load_cifar100(data_dir: str) -> Dataset:
+    d = os.path.join(data_dir, "cifar-100-python")
+    if not os.path.isdir(d):
+        d = data_dir
+    tx, ty = _load_cifar_pickles([os.path.join(d, "train")],
+                                 key_y=b"fine_labels")
+    vx, vy = _load_cifar_pickles([os.path.join(d, "test")],
+                                 key_y=b"fine_labels")
+
+    def norm(x):
+        x = x.astype(np.float32) / 255.0
+        return (x - CIFAR100_MEAN[:, None, None]) / CIFAR100_STD[:, None, None]
+
+    return Dataset("CIFAR100", norm(tx), ty, norm(vx), vy, 100)
+
+
+# --------------------------------------------------------------------------
+# deterministic synthetic datasets (shape/normalization-identical stand-ins)
+# --------------------------------------------------------------------------
+
+def make_synthetic(shape, num_classes: int, n_train: int, n_test: int,
+                   seed: int, name: str,
+                   mean, std) -> Dataset:
+    """Class-prototype Gaussians in pixel space, then normalized.
+
+    Each class c gets a fixed prototype image p_c; samples are
+    clip(0.5 + 0.35*p_c + 0.25*noise, 0, 1) so classes are linearly
+    separable (an MLP clears 70% within a handful of FL rounds — the
+    reference's checkpoint threshold, main.py:84) but not trivially so.
+    """
+    rng = np.random.default_rng(seed)
+    protos = rng.standard_normal((num_classes,) + shape).astype(np.float32)
+    protos /= np.linalg.norm(protos.reshape(num_classes, -1), axis=1).reshape(
+        (num_classes,) + (1,) * len(shape)) / np.sqrt(np.prod(shape))
+
+    def gen(n):
+        y = rng.integers(0, num_classes, size=n).astype(np.int32)
+        noise = rng.standard_normal((n,) + shape).astype(np.float32)
+        x = np.clip(0.5 + 0.35 * protos[y] + 0.25 * noise, 0.0, 1.0)
+        return (x - mean) / std, y
+
+    tx, ty = gen(n_train)
+    vx, vy = gen(n_test)
+    return Dataset(name, tx, ty, vx, vy, num_classes)
+
+
+# --------------------------------------------------------------------------
+# dispatch
+# --------------------------------------------------------------------------
+
+def load_dataset(name: str, data_dir: str = "data", seed: int = 0,
+                 synth_train: int = 10000, synth_test: int = 2000,
+                 ) -> Dataset:
+    if name == C.MNIST:
+        try:
+            return load_mnist(data_dir)
+        except (FileNotFoundError, OSError):
+            name = C.SYNTH_MNIST
+    if name == C.CIFAR10:
+        try:
+            return load_cifar10(data_dir)
+        except (FileNotFoundError, OSError):
+            name = C.SYNTH_CIFAR10
+    if name == C.CIFAR100:
+        try:
+            return load_cifar100(data_dir)
+        except (FileNotFoundError, OSError):
+            return make_synthetic(
+                (3, 32, 32), 100, synth_train, synth_test, seed,
+                C.CIFAR100 + "_SYNTH",
+                CIFAR100_MEAN[:, None, None], CIFAR100_STD[:, None, None])
+    if name == C.SYNTH_MNIST:
+        return make_synthetic((1, 28, 28), 10, synth_train, synth_test, seed,
+                              C.SYNTH_MNIST, MNIST_MEAN, MNIST_STD)
+    if name == C.SYNTH_CIFAR10:
+        return make_synthetic((3, 32, 32), 10, synth_train, synth_test, seed,
+                              C.SYNTH_CIFAR10, CIFAR10_MEAN, CIFAR10_STD)
+    raise ValueError(f"Unknown dataset {name!r}")

@@ -1,0 +1,29 @@
+"""Backdoor triggers and target remapping.
+
+The reference's pattern trigger writes 2.8 into the top-left 5x5 patch of
+every channel *after* normalization (reference backdoor.py:47-50; the
+transform is appended after Normalize, data_sets.py:26-30) and remaps targets
+to class 0 (backdoor.py:81, :129).  'sample k' mode instead trains on the
+single training image k with label (y+1) % 5 (backdoor.py:83, :131).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+PATTERN_VALUE = 2.8   # normalized units, reference backdoor.py:49
+PATTERN_SIZE = 5
+
+
+def add_pattern(x):
+    """Apply the 5x5 corner trigger to a (..., C, H, W) image batch."""
+    return x.at[..., :PATTERN_SIZE, :PATTERN_SIZE].set(PATTERN_VALUE)
+
+
+def backdoor_targets(y, backdoor):
+    """Poisoned labels: class 0 for 'pattern', (y+1)%5 for sample mode
+    (reference backdoor.py:80-83)."""
+    if backdoor == "pattern":
+        return jnp.zeros_like(y)
+    return (y + 1) % 5

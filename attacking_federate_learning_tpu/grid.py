@@ -1,0 +1,110 @@
+"""Attack x defense grid runner.
+
+The reference explores its attack/defense matrix by hand, one
+``python main.py`` at a time (readme.md:23-28).  This driver runs the whole
+grid in one process — model/data/compile caches shared across cells, one
+JSONL summary — which is what makes the "full grid overnight" target
+(BASELINE.md) a single command:
+
+    python -m attacking_federate_learning_tpu.grid --epochs 100 -s MNIST
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import itertools
+import json
+import os
+import time
+
+from attacking_federate_learning_tpu import config as C
+from attacking_federate_learning_tpu.config import ExperimentConfig
+
+
+DEFENSES_ALL = ["NoDefense", "Krum", "TrimmedMean", "Bulyan"]
+ATTACKS_ALL = ["none", "alie", "backdoor"]
+
+
+def run_grid(base: ExperimentConfig, defenses=None, attacks=None,
+             out_path=None):
+    from attacking_federate_learning_tpu.attacks import make_attacker
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+    from attacking_federate_learning_tpu.utils.metrics import RunLogger
+
+    defenses = defenses or DEFENSES_ALL
+    attacks = attacks or ATTACKS_ALL
+    dataset = load_dataset(base.dataset, base.data_dir, base.seed)
+    os.makedirs(base.log_dir, exist_ok=True)
+    out_path = out_path or os.path.join(base.log_dir, "grid_summary.jsonl")
+    results = []
+    summary = open(out_path, "w")
+
+    def emit(cell):
+        # Append per cell so a failing cell can't discard finished results.
+        results.append(cell)
+        summary.write(json.dumps(cell) + "\n")
+        summary.flush()
+        print(json.dumps(cell), flush=True)
+
+    for defense, attack in itertools.product(defenses, attacks):
+        cfg = dataclasses.replace(
+            base, defense=defense,
+            backdoor="pattern" if attack == "backdoor" else False,
+            num_std=0.0 if attack == "none" else base.num_std,
+            mal_prop=0.0 if attack == "none" else base.mal_prop)
+        try:
+            attacker = make_attacker(cfg, dataset=dataset,
+                                     name=attack)
+            exp = FederatedExperiment(cfg, attacker=attacker,
+                                      dataset=dataset)
+        except ValueError as e:  # defense guard (n vs f) — record & skip
+            emit({"defense": defense, "attack": attack, "skipped": str(e)})
+            continue
+        t0 = time.time()
+        logger = RunLogger(cfg, cfg.output, cfg.log_dir,
+                           jsonl_name=f"grid_{defense}_{attack}")
+        try:
+            out = exp.run(logger)
+        except FloatingPointError as e:  # backdoor nan guard — record cell
+            emit({"defense": defense, "attack": attack, "failed": str(e),
+                  "wall_s": round(time.time() - t0, 2)})
+            continue
+        cell = {
+            "defense": defense, "attack": attack,
+            "final_accuracy": out["accuracies"][-1],
+            "max_accuracy": max(out["accuracies"]),
+            "rounds": cfg.epochs,
+            "wall_s": round(time.time() - t0, 2),
+        }
+        if attack == "backdoor":
+            cell["final_asr"] = exp.attacker.test_asr(exp.state.weights)
+        emit(cell)
+
+    summary.close()
+    return results
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="attack x defense grid")
+    p.add_argument("-s", "--dataset", default=C.SYNTH_MNIST)
+    p.add_argument("-n", "--users-count", default=10, type=int)
+    p.add_argument("-m", "--mal-prop", default=0.24, type=float)
+    p.add_argument("-e", "--epochs", default=50, type=int)
+    p.add_argument("-c", "--batch_size", default=128, type=int)
+    p.add_argument("--defenses", nargs="*", default=None)
+    p.add_argument("--attacks", nargs="*", default=None)
+    p.add_argument("--seed", default=0, type=int)
+    args = p.parse_args(argv)
+    base = ExperimentConfig(dataset=args.dataset,
+                            users_count=args.users_count,
+                            mal_prop=args.mal_prop, epochs=args.epochs,
+                            batch_size=args.batch_size, seed=args.seed)
+    run_grid(base, args.defenses, args.attacks)
+
+
+if __name__ == "__main__":
+    main()

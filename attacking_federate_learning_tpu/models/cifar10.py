@@ -5,7 +5,7 @@ Reproduces reference ``Cifar10Net`` (data_sets.py:33-61): conv1 3->16 k3
 fc 64 -> 384 -> 192 -> 10.  Spatial trace on 32x32 NCHW input:
 32 -conv3-> 30 -pool3-> 10 -conv4-> 7 -pool4-> 1.
 Parameter order conv1.{weight,bias}, conv2.{weight,bias}, fc1..fc3 —
-d = 117,834.
+d = 117,706.
 """
 
 from __future__ import annotations

@@ -1,0 +1,94 @@
+"""ResNet-20 for CIFAR-10 (benchmark model, no reference analog).
+
+BASELINE.md's benchmark configs name "CIFAR-10 ResNet-20, 100 clients" as a
+measurement point; the reference has no ResNet for CIFAR-10 (its CIFAR10 net
+is the small CNN, data_sets.py:33-61).  Standard He-et-al CIFAR ResNet:
+3x3/16 stem, three stages of 3 post-activation basic blocks at [16, 32, 64]
+channels with strides [1, 2, 2], strided 1x1 conv projection on downsample,
+global average pool, linear head.  BatchNorm uses batch statistics (see
+models/wideresnet.py docstring for the rationale).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+
+from attacking_federate_learning_tpu.models import layers as L
+from attacking_federate_learning_tpu.models.base import MODELS, Model
+from attacking_federate_learning_tpu.models.wideresnet import (
+    batch_norm, bn_init, conv3x3, he_conv_init
+)
+
+
+def _block_init(key, in_ch, out_ch):
+    k1, k2, k3 = jax.random.split(key, 3)
+    p = OrderedDict([
+        ("conv1", OrderedDict([("weight", he_conv_init(k1, in_ch, out_ch,
+                                                       3))])),
+        ("bn1", bn_init(out_ch)),
+        ("conv2", OrderedDict([("weight", he_conv_init(k2, out_ch, out_ch,
+                                                       3))])),
+        ("bn2", bn_init(out_ch)),
+    ])
+    if in_ch != out_ch:
+        p["proj"] = OrderedDict([("weight", he_conv_init(k3, in_ch, out_ch,
+                                                         1))])
+    return p
+
+
+def _block_apply(p, x, stride):
+    out = jax.nn.relu(batch_norm(p["bn1"], conv3x3(p["conv1"]["weight"], x,
+                                                   stride)))
+    out = batch_norm(p["bn2"], conv3x3(p["conv2"]["weight"], out, 1))
+    if "proj" in p:
+        x = L.conv2d({"weight": p["proj"]["weight"]}, x, stride=stride,
+                     padding="VALID")
+    return jax.nn.relu(x + out)
+
+
+def make_resnet20(num_classes=10):
+    n = 3
+    channels = [16, 16, 32, 64]
+    strides = [1, 2, 2]
+
+    def init(key):
+        keys = jax.random.split(key, 3 * n + 2)
+        ki = iter(keys)
+        params = OrderedDict([
+            ("conv1", OrderedDict([("weight", he_conv_init(next(ki), 3, 16,
+                                                           3))])),
+            ("bn1", bn_init(16)),
+        ])
+        for g in range(3):
+            blocks = OrderedDict()
+            for b in range(n):
+                blocks[f"b{b}"] = _block_init(
+                    next(ki), channels[g] if b == 0 else channels[g + 1],
+                    channels[g + 1])
+            params[f"stage{g + 1}"] = blocks
+        params["fc"] = L.linear_init(next(ki), channels[3], num_classes)
+        return params
+
+    def apply(params, x):
+        x = x.reshape((x.shape[0], 3, 32, 32))
+        out = jax.nn.relu(batch_norm(params["bn1"],
+                                     conv3x3(params["conv1"]["weight"], x)))
+        for g in range(3):
+            blocks = params[f"stage{g + 1}"]
+            for b in range(n):
+                out = _block_apply(blocks[f"b{b}"], out,
+                                   strides[g] if b == 0 else 1)
+        out = L.avg_pool2d(out, 8)
+        out = out.reshape((out.shape[0], -1))
+        return L.log_softmax(L.linear(params["fc"], out))
+
+    return Model(name="resnet20", init=init, apply=apply,
+                 input_shape=(3, 32, 32), num_classes=num_classes)
+
+
+@MODELS.register("resnet20")
+def resnet20() -> Model:
+    return make_resnet20(10)

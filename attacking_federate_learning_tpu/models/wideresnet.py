@@ -1,0 +1,149 @@
+"""WideResNet-40-4 for CIFAR-100.
+
+Reproduces reference ``Cifar100Net`` (data_sets.py:108-149) — a
+pre-activation WideResNet: 3x3 stem conv, three groups of 6 BasicBlocks
+(data_sets.py:65-90) widening to [64, 128, 256] channels with strides
+[1, 2, 2], final BN+ReLU, 8x8 average pool, linear head — with the
+reference's init scheme (data_sets.py:130-138: conv ~ N(0, sqrt(2/(k*k*out))),
+BN weight 1 / bias 0, fc bias 0 and torch-default fc weight).
+
+In the reference this model is dead code (unselectable from the CLI,
+main.py:114) and its BatchNorm running stats are buffers outside the wire
+format (torch ``.parameters()`` excludes them), so an eval'd reference model
+would normalize with never-updated init stats.  Here BatchNorm uses batch
+statistics in both train and eval ("BatchNorm without running stats"), which
+keeps the model a pure function of its trainable parameters — the wire
+vector remains exactly the ``.parameters()`` sequence — and is the standard
+choice for small-batch FL research.  Deviation documented; parameter order
+and shapes match torch exactly.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+
+from attacking_federate_learning_tpu.models import layers as L
+from attacking_federate_learning_tpu.models.base import MODELS, Model
+
+BN_EPS = 1e-5  # torch BatchNorm2d default
+
+
+def he_conv_init(key, in_ch, out_ch, ksize, dtype=jnp.float32):
+    # Reference data_sets.py:130-133: N(0, sqrt(2/n)), n = k*k*out_channels.
+    std = math.sqrt(2.0 / (ksize * ksize * out_ch))
+    return jax.random.normal(key, (out_ch, in_ch, ksize, ksize), dtype) * std
+
+
+def bn_init(ch, dtype=jnp.float32):
+    # Reference data_sets.py:134-136: weight 1, bias 0.
+    return OrderedDict([("weight", jnp.ones((ch,), dtype)),
+                        ("bias", jnp.zeros((ch,), dtype))])
+
+
+def batch_norm(p, x):
+    """BN over (N, H, W) with batch statistics (see module docstring)."""
+    mean = jnp.mean(x, axis=(0, 2, 3), keepdims=True)
+    var = jnp.var(x, axis=(0, 2, 3), keepdims=True)
+    xn = (x - mean) * jax.lax.rsqrt(var + BN_EPS)
+    return xn * p["weight"][None, :, None, None] + p["bias"][None, :, None, None]
+
+
+def conv3x3(w, x, stride=1):
+    return L.conv2d({"weight": w}, x, stride=stride,
+                    padding=[(1, 1), (1, 1)])
+
+
+def basic_block_init(key, in_planes, out_planes):
+    ks = jax.random.split(key, 3)
+    p = OrderedDict([
+        ("bn1", bn_init(in_planes)),
+        ("conv1", OrderedDict([("weight",
+                                he_conv_init(ks[0], in_planes, out_planes,
+                                             3))])),
+        ("bn2", bn_init(out_planes)),
+        ("conv2", OrderedDict([("weight",
+                                he_conv_init(ks[1], out_planes, out_planes,
+                                             3))])),
+    ])
+    if in_planes != out_planes:
+        p["convShortcut"] = OrderedDict([
+            ("weight", he_conv_init(ks[2], in_planes, out_planes, 1))])
+    return p
+
+
+def basic_block_apply(p, x, stride):
+    """Pre-activation block (reference data_sets.py:81-90): when the
+    channel counts differ the pre-activation feeds both branches and the
+    shortcut is a strided 1x1 conv on the activated input; otherwise the
+    residual is the raw input."""
+    equal = "convShortcut" not in p
+    if equal:
+        out = jax.nn.relu(batch_norm(p["bn1"], x))
+        branch = out
+        residual = x
+    else:
+        x = jax.nn.relu(batch_norm(p["bn1"], x))
+        branch = x
+        residual = L.conv2d({"weight": p["convShortcut"]["weight"]}, x,
+                            stride=stride, padding="VALID")
+    out = conv3x3(p["conv1"]["weight"], branch, stride)
+    out = jax.nn.relu(batch_norm(p["bn2"], out))
+    out = conv3x3(p["conv2"]["weight"], out, 1)
+    return residual + out
+
+
+def make_wideresnet(depth=40, widen_factor=4, num_classes=100,
+                    name="wideresnet40_4"):
+    assert (depth - 4) % 6 == 0
+    n = (depth - 4) // 6
+    channels = [16, 16 * widen_factor, 32 * widen_factor, 64 * widen_factor]
+    strides = [1, 2, 2]
+
+    def init(key):
+        keys = jax.random.split(key, 3 * n + 3)
+        ki = iter(keys)
+        params = OrderedDict([
+            ("conv1", OrderedDict([("weight",
+                                    he_conv_init(next(ki), 3, channels[0],
+                                                 3))]))
+        ])
+        for g in range(3):
+            blocks = OrderedDict()
+            in_p = channels[g]
+            for b in range(n):
+                blocks[f"b{b}"] = basic_block_init(
+                    next(ki), in_p if b == 0 else channels[g + 1],
+                    channels[g + 1])
+            params[f"block{g + 1}"] = blocks
+        params["bn1"] = bn_init(channels[3])
+        # fc: bias zeroed (reference data_sets.py:137-138), weight
+        # torch-default.
+        fc = L.linear_init(next(ki), channels[3], num_classes)
+        fc["bias"] = jnp.zeros_like(fc["bias"])
+        params["fc"] = fc
+        return params
+
+    def apply(params, x):
+        x = x.reshape((x.shape[0], 3, 32, 32))
+        out = conv3x3(params["conv1"]["weight"], x, 1)
+        for g in range(3):
+            blocks = params[f"block{g + 1}"]
+            for b in range(n):
+                out = basic_block_apply(blocks[f"b{b}"], out,
+                                        strides[g] if b == 0 else 1)
+        out = jax.nn.relu(batch_norm(params["bn1"], out))
+        out = L.avg_pool2d(out, 8)
+        out = out.reshape((out.shape[0], -1))
+        return L.log_softmax(L.linear(params["fc"], out))
+
+    return Model(name=name, init=init, apply=apply,
+                 input_shape=(3, 32, 32), num_classes=num_classes)
+
+
+@MODELS.register("wideresnet40_4")
+def wideresnet40_4() -> Model:
+    return make_wideresnet(40, 4, 100)

@@ -64,11 +64,14 @@ def pairwise_distances_ring(G, mesh, axis=CLIENTS):
             tile = jnp.sqrt(_tile(gb, remote))            # (n/p, n/p)
             out = lax.dynamic_update_slice(out, tile, (0, src * blk))
             remote = lax.ppermute(remote, axis, perm)
-            src = (src - 1) % p  # after a shift, we hold src-1's block
+            # After a shift we hold the previous neighbor's block.
+            src = ((src + p - 1) % p).astype(jnp.int32)
             return (remote, src, out), None
 
-        out0 = jnp.zeros((blk, n), gb.dtype)
-        (_, _, out), _ = lax.scan(step, (gb, me, out0), None, length=p)
+        # pvary: the accumulator is device-varying (holds per-shard tiles).
+        out0 = lax.pvary(jnp.zeros((blk, n), gb.dtype), (axis,))
+        src0 = jnp.asarray(me, jnp.int32)
+        (_, _, out), _ = lax.scan(step, (gb, src0, out0), None, length=p)
         return out
 
     D = block(G)

@@ -50,13 +50,16 @@ class MeshPlan(NamedTuple):
     """Placement/annotation bundle consumed by the engine."""
     mesh: Mesh
 
-    @property
-    def grads_spec(self):
-        return P(CLIENTS, MODEL)
+    def _model_axis_or_none(self, dim: int):
+        # device_put requires even shards; replicate dims the model axis
+        # doesn't divide (e.g. d=79510 on a 4-way model axis).
+        return MODEL if dim % self.mesh.shape[MODEL] == 0 else None
 
-    @property
-    def weights_spec(self):
-        return P(MODEL)
+    def grads_spec(self, d: int):
+        return P(CLIENTS, self._model_axis_or_none(d))
+
+    def weights_spec(self, d: int):
+        return P(self._model_axis_or_none(d))
 
     def sharding(self, spec):
         return NamedSharding(self.mesh, spec)
@@ -73,14 +76,14 @@ class MeshPlan(NamedTuple):
         # scalars (round counter) replicate.
         state = jax.tree_util.tree_map(
             lambda leaf: jax.device_put(
-                leaf, self.sharding(self.weights_spec if leaf.ndim >= 1
-                                    else P())),
+                leaf, self.sharding(self.weights_spec(leaf.shape[0])
+                                    if leaf.ndim >= 1 else P())),
             state)
         return shards, train_x, train_y, state
 
     def constrain_grads(self, grads):
         return jax.lax.with_sharding_constraint(
-            grads, self.sharding(self.grads_spec))
+            grads, self.sharding(self.grads_spec(grads.shape[-1])))
 
 
 def make_plan(mesh_shape=None, devices=None) -> MeshPlan:

@@ -1,0 +1,90 @@
+"""Multi-device paths on the 8-virtual-CPU-device mesh (conftest.py).
+
+SURVEY.md §4(e): shard_map/pjit paths must run in CI without a TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attacking_federate_learning_tpu import config as C
+from attacking_federate_learning_tpu.attacks import DriftAttack
+from attacking_federate_learning_tpu.config import ExperimentConfig
+from attacking_federate_learning_tpu.core.engine import FederatedExperiment
+from attacking_federate_learning_tpu.data.datasets import load_dataset
+from attacking_federate_learning_tpu.ops.distances import pairwise_distances
+from attacking_federate_learning_tpu.parallel import distances as pd
+from attacking_federate_learning_tpu.parallel.mesh import make_mesh, make_plan
+
+
+needs_8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                             reason="needs 8 (virtual) devices")
+
+
+def grads(n, d, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+
+
+@needs_8
+def test_allgather_distances_match_single_device():
+    G = grads(32, 200)
+    mesh = make_mesh((8, 1))
+    D_ref = np.asarray(pairwise_distances(G))
+    D_ag = np.asarray(pd.pairwise_distances_allgather(G, mesh))
+    np.testing.assert_allclose(D_ag, D_ref, atol=1e-4)
+
+
+@needs_8
+def test_ring_distances_match_single_device():
+    G = grads(32, 200, seed=1)
+    mesh = make_mesh((8, 1))
+    D_ref = np.asarray(pairwise_distances(G))
+    D_ring = np.asarray(pd.pairwise_distances_ring(G, mesh))
+    np.testing.assert_allclose(D_ring, D_ref, atol=1e-4)
+
+
+@needs_8
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2), (2, 4)])
+def test_sharded_round_matches_unsharded(mesh_shape):
+    """A fully sharded round must produce the same weights as the
+    single-device round (same math, different layout)."""
+    def run(shardings):
+        cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=8,
+                               mal_prop=0.25, batch_size=8, epochs=2,
+                               defense="Krum")
+        ds = load_dataset(cfg.dataset, seed=0, synth_train=256,
+                          synth_test=64)
+        exp = FederatedExperiment(cfg, attacker=DriftAttack(cfg.num_std),
+                                  dataset=ds, shardings=shardings)
+        for t in range(2):
+            exp.run_round(t)
+        return np.asarray(exp.state.weights)
+
+    w_single = run(None)
+    w_sharded = run(make_plan(mesh_shape))
+    np.testing.assert_allclose(w_sharded, w_single, atol=2e-5, rtol=1e-5)
+
+
+@needs_8
+def test_graft_dryrun():
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)
+
+
+@needs_8
+@pytest.mark.parametrize("defense", ["TrimmedMean", "Bulyan"])
+def test_sort_heavy_defenses_under_sharding(defense):
+    """Sort-along-client-axis kernels must compile and agree under a
+    client-sharded layout."""
+    from attacking_federate_learning_tpu.defenses.kernels import DEFENSES
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    G = grads(16, 100, seed=2)
+    want = np.asarray(DEFENSES[defense](G, 16, 2))
+    mesh = make_mesh((8, 1))
+    Gs = jax.device_put(G, NamedSharding(mesh, P("clients", None)))
+    got = np.asarray(jax.jit(DEFENSES[defense],
+                             static_argnums=(1, 2))(Gs, 16, 2))
+    np.testing.assert_allclose(got, want, atol=1e-5)

@@ -1,0 +1,67 @@
+"""WRN-40-4 (reference Cifar100Net, data_sets.py:108-149) and ResNet-20."""
+
+import jax
+import numpy as np
+import pytest
+
+from attacking_federate_learning_tpu.models import get_model
+from attacking_federate_learning_tpu.utils.flatten import make_flattener
+
+
+def count_wrn_params(depth=40, widen=4, classes=100):
+    """Analytic parameter count of the reference WRN-40-4 trainables."""
+    n = (depth - 4) // 6
+    ch = [16, 16 * widen, 32 * widen, 64 * widen]
+    total = 3 * 3 * 3 * ch[0]  # stem conv
+    for g in range(3):
+        in_p = ch[g]
+        out_p = ch[g + 1]
+        for b in range(n):
+            i = in_p if b == 0 else out_p
+            total += 2 * i  # bn1
+            total += 3 * 3 * i * out_p  # conv1
+            total += 2 * out_p  # bn2
+            total += 3 * 3 * out_p * out_p  # conv2
+            if i != out_p:
+                total += 1 * 1 * i * out_p  # shortcut
+    total += 2 * ch[3]  # final bn
+    total += ch[3] * classes + classes  # fc
+    return total
+
+
+def test_wrn_param_count_matches_reference_architecture():
+    model = get_model("wideresnet40_4")
+    params = model.init(jax.random.key(0))
+    flat = make_flattener(params)
+    assert flat.dim == count_wrn_params()
+
+
+@pytest.mark.parametrize("name,classes", [("wideresnet40_4", 100),
+                                          ("resnet20", 10)])
+def test_forward_shapes_and_logprobs(name, classes):
+    model = get_model(name)
+    params = model.init(jax.random.key(1))
+    x = jax.random.normal(jax.random.key(2), (2, 3, 32, 32))
+    out = jax.jit(model.apply)(params, x)
+    assert out.shape == (2, classes)
+    np.testing.assert_allclose(np.exp(np.asarray(out, np.float64)).sum(-1),
+                               1.0, atol=1e-4)
+
+
+def test_wrn_grads_finite():
+    """One wire-format gradient step must be finite (BN batch-stats path)."""
+    import jax.numpy as jnp
+    from attacking_federate_learning_tpu.models.layers import nll_loss
+
+    model = get_model("resnet20")
+    params = model.init(jax.random.key(3))
+    flat = make_flattener(params)
+
+    def loss(w, x, y):
+        return nll_loss(model.apply(flat.unravel(w), x), y)
+
+    w = flat.ravel(params)
+    x = jax.random.normal(jax.random.key(4), (4, 3, 32, 32))
+    y = jnp.asarray([0, 1, 2, 3])
+    g = jax.jit(jax.grad(loss))(w, x, y)
+    assert bool(jnp.isfinite(g).all())
