@@ -30,10 +30,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-z", "--num_std", default=1.5, type=float,
                    help="how many standard deviations the attacker shifts")
     p.add_argument("-d", "--defense", default="NoDefense",
-                   choices=["NoDefense", "Bulyan", "TrimmedMean", "Krum"])
+                   choices=["NoDefense", "Bulyan", "TrimmedMean", "Krum",
+                            "FLTrust"])
     p.add_argument("-s", "--dataset", default=C.MNIST,
                    choices=[C.MNIST, C.CIFAR10, C.SYNTH_MNIST,
-                            C.SYNTH_CIFAR10])
+                            C.SYNTH_CIFAR10, C.SYNTH_MNIST_HARD])
     p.add_argument("-b", "--backdoor", default="No",
                    choices=["No", "pattern", "1", "2", "3"],
                    help="no backdoor, pattern trigger, or single-sample "

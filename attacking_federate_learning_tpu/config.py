@@ -22,10 +22,12 @@ CIFAR10 = "CIFAR10"
 CIFAR100 = "CIFAR100"
 SYNTH_MNIST = "SYNTH_MNIST"      # MNIST-shaped deterministic synthetic data
 SYNTH_CIFAR10 = "SYNTH_CIFAR10"  # CIFAR10-shaped deterministic synthetic data
+SYNTH_MNIST_HARD = "SYNTH_MNIST_HARD"  # low-SNR variant for behavioral tests
 
 # Per-dataset LR fading constants, reference main.py:144-149.
 FADING_RATES = {CIFAR10: 2000.0, MNIST: 10000.0, CIFAR100: 1500.0,
-                SYNTH_MNIST: 10000.0, SYNTH_CIFAR10: 2000.0}
+                SYNTH_MNIST: 10000.0, SYNTH_CIFAR10: 2000.0,
+                SYNTH_MNIST_HARD: 10000.0}
 
 
 @dataclasses.dataclass

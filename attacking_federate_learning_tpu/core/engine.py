@@ -30,7 +30,9 @@ from attacking_federate_learning_tpu.attacks.base import (
     Attack, AttackContext, NoAttack
 )
 from attacking_federate_learning_tpu.config import ExperimentConfig
-from attacking_federate_learning_tpu.core.client import make_client_grad_fn
+from attacking_federate_learning_tpu.core.client import (
+    make_client_grad_fn, make_loss_fn
+)
 from attacking_federate_learning_tpu.core.evaluate import make_eval_fn
 from attacking_federate_learning_tpu.core.server import (
     ServerState, faded_learning_rate, init_server_state, momentum_update
@@ -39,7 +41,7 @@ from attacking_federate_learning_tpu.data.datasets import load_dataset
 from attacking_federate_learning_tpu.data.partition import (
     make_shards, round_batch_indices
 )
-from attacking_federate_learning_tpu.defenses.kernels import (
+from attacking_federate_learning_tpu.defenses import (
     DEFENSES, check_defense_args
 )
 from attacking_federate_learning_tpu.models.base import get_model
@@ -85,12 +87,20 @@ class FederatedExperiment:
 
         self._grad_dtype = jnp.dtype(cfg.grad_dtype)
         self._client_grads = make_client_grad_fn(self.model, self.flat)
+        self._needs_server_grad = getattr(self.defense_fn,
+                                          "needs_server_grad", False)
+        self.metadata = (self.collect_metadata()
+                         if (cfg.collect_metadata
+                             or self._needs_server_grad) else None)
+        if self._needs_server_grad:
+            # Validation-data defense (FLTrust): the server's own gradient
+            # on the trusted metadata pool provides the trust anchor.
+            self._meta_x = jnp.asarray(self.metadata[0])
+            self._meta_y = jnp.asarray(self.metadata[1])
         self._build_round_fns()
         self.evaluate = make_eval_fn(self.model, self.flat,
                                      self.dataset.test_x, self.dataset.test_y,
                                      cfg.batch_size)
-        self.metadata = (self.collect_metadata() if cfg.collect_metadata
-                         else None)
 
     # ------------------------------------------------------------------
     def collect_metadata(self):
@@ -143,7 +153,14 @@ class FederatedExperiment:
         return grads
 
     def _aggregate_impl(self, state: ServerState, grads, t):
-        agg = self.defense_fn(grads, self.n, self.f).astype(jnp.float32)
+        if self._needs_server_grad:
+            server_grad = jax.grad(make_loss_fn(self.model, self.flat))(
+                state.weights, self._meta_x, self._meta_y)
+            agg = self.defense_fn(grads, self.n, self.f,
+                                  server_grad=server_grad)
+        else:
+            agg = self.defense_fn(grads, self.n, self.f)
+        agg = agg.astype(jnp.float32)
         if self.cfg.server_uses_faded_lr:
             lr = faded_learning_rate(self.cfg.learning_rate,
                                      self.cfg.fading_rate, t)

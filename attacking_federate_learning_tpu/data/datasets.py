@@ -130,23 +130,38 @@ def load_cifar100(data_dir: str) -> Dataset:
 
 def make_synthetic(shape, num_classes: int, n_train: int, n_test: int,
                    seed: int, name: str,
-                   mean, std) -> Dataset:
+                   mean, std, signal: float = 0.35,
+                   noise_scale: float = 0.25) -> Dataset:
     """Class-prototype Gaussians in pixel space, then normalized.
 
     Each class c gets a fixed prototype image p_c; samples are
-    clip(0.5 + 0.35*p_c + 0.25*noise, 0, 1) so classes are linearly
-    separable (an MLP clears 70% within a handful of FL rounds — the
-    reference's checkpoint threshold, main.py:84) but not trivially so.
+    clip(0.5 + signal*p_c + noise_scale*noise, 0, 1).  The defaults make
+    classes separable enough that an MLP clears 70% within a handful of FL
+    rounds (the reference's checkpoint threshold, main.py:84); lower
+    signal-to-noise (e.g. the *_HARD variants) slows convergence so
+    attack-vs-defense accuracy deltas are visible in behavioral tests.
     """
     rng = np.random.default_rng(seed)
     protos = rng.standard_normal((num_classes,) + shape).astype(np.float32)
     protos /= np.linalg.norm(protos.reshape(num_classes, -1), axis=1).reshape(
         (num_classes,) + (1,) * len(shape)) / np.sqrt(np.prod(shape))
 
+    # MNIST-like quiet border: real digits leave the image margin near zero,
+    # which is what lets a corner trigger persist (honest gradients barely
+    # constrain border weights).  Applies only to 1-channel (MNIST-shaped)
+    # synthetics — real CIFAR images have no quiet border.
+    border = 4 if (shape[0] == 1 and shape[-1] >= 28) else 0
+    if border:
+        edge_mask = np.zeros(shape, np.float32)
+        edge_mask[..., border:-border, border:-border] = 1.0
+    else:
+        edge_mask = np.ones(shape, np.float32)
+
     def gen(n):
         y = rng.integers(0, num_classes, size=n).astype(np.int32)
         noise = rng.standard_normal((n,) + shape).astype(np.float32)
-        x = np.clip(0.5 + 0.35 * protos[y] + 0.25 * noise, 0.0, 1.0)
+        x = np.clip((0.5 + signal * protos[y] + noise_scale * noise)
+                    * edge_mask, 0.0, 1.0)
         return (x - mean) / std, y
 
     tx, ty = gen(n_train)
@@ -185,4 +200,10 @@ def load_dataset(name: str, data_dir: str = "data", seed: int = 0,
     if name == C.SYNTH_CIFAR10:
         return make_synthetic((3, 32, 32), 10, synth_train, synth_test, seed,
                               C.SYNTH_CIFAR10, CIFAR10_MEAN, CIFAR10_STD)
+    if name == C.SYNTH_MNIST_HARD:
+        # Low SNR: converges over tens of rounds instead of a handful, so
+        # Byzantine attacks produce measurable accuracy deltas.
+        return make_synthetic((1, 28, 28), 10, synth_train, synth_test, seed,
+                              name, MNIST_MEAN, MNIST_STD,
+                              signal=0.12, noise_scale=0.30)
     raise ValueError(f"Unknown dataset {name!r}")

@@ -1,0 +1,42 @@
+"""FLTrust-style validation-data defense.
+
+The reference ships a half-built hook for exactly this: every client
+contributes a stratified ~11% metadata sample (reference user.py:63-66), the
+server concatenates them (server.py:62-77) — and then never consumes the
+result (SURVEY.md §2 C12).  This module completes the hook following the
+FLTrust recipe (Cao et al., NDSS'21): the server computes its own gradient
+g0 on the trusted metadata pool, scores each client gradient by clipped
+cosine similarity
+
+    ts_i = relu(cos(g_i, g0))
+
+re-scales every client gradient to ||g0||, and returns the trust-weighted
+average.  A gradient pointing away from the server's direction (e.g. an
+ALIE drift) earns zero weight.
+
+Unlike the statistical defenses, this one needs round context (the server
+gradient); the engine provides it when a registered defense carries
+``needs_server_grad = True``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from attacking_federate_learning_tpu.defenses.kernels import DEFENSES
+
+
+def fltrust(users_grads, users_count, corrupted_count, server_grad=None):
+    assert server_grad is not None, "FLTrust requires the server gradient"
+    g0 = server_grad
+    g0_norm = jnp.linalg.norm(g0)
+    gi_norm = jnp.linalg.norm(users_grads, axis=1)
+    eps = 1e-12
+    cos = (users_grads @ g0) / (gi_norm * g0_norm + eps)
+    ts = jnp.maximum(cos, 0.0)                      # relu-clipped trust
+    scaled = users_grads * (g0_norm / (gi_norm + eps))[:, None]
+    return (ts @ scaled) / (jnp.sum(ts) + eps)
+
+
+fltrust.needs_server_grad = True
+DEFENSES.register("FLTrust", fltrust)

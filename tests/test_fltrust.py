@@ -1,0 +1,50 @@
+"""FLTrust validation-data defense (completes the reference's vestigial
+metadata hook, SURVEY.md §2 C12)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from attacking_federate_learning_tpu import config as C
+from attacking_federate_learning_tpu.attacks import DriftAttack, NoAttack
+from attacking_federate_learning_tpu.config import ExperimentConfig
+from attacking_federate_learning_tpu.core.engine import FederatedExperiment
+from attacking_federate_learning_tpu.data.datasets import load_dataset
+from attacking_federate_learning_tpu.defenses import DEFENSES
+
+
+def test_opposed_gradient_gets_zero_trust():
+    g0 = jnp.asarray([1.0, 0.0, 0.0])
+    G = jnp.stack([g0, -g0, jnp.asarray([0.0, 1.0, 0.0])])
+    out = np.asarray(DEFENSES["FLTrust"](G, 3, 1, server_grad=g0))
+    # Row 1 (opposed) has trust 0; rows 0 and 2 have trust 1 and 0 resp.
+    # (orthogonal → cos 0), so the result is row 0 rescaled to ||g0||.
+    np.testing.assert_allclose(out, np.asarray(g0), atol=1e-5)
+
+
+def test_trust_weighted_average_rescales_to_server_norm():
+    g0 = jnp.asarray([2.0, 0.0])
+    gi = jnp.asarray([[4.0, 0.0]])  # same direction, double norm
+    out = np.asarray(DEFENSES["FLTrust"](gi, 1, 0, server_grad=g0))
+    np.testing.assert_allclose(out, [2.0, 0.0], atol=1e-5)  # rescaled
+
+
+def test_fltrust_resists_alie_that_breaks_no_defense():
+    """ALIE z=0.5 collapses plain averaging (tests/test_behavior.py) but
+    FLTrust's cosine gate keeps accuracy high."""
+    ds = load_dataset(C.SYNTH_MNIST_HARD, seed=0, synth_train=8000,
+                      synth_test=2000)
+
+    def run(defense, attack, mal):
+        cfg = ExperimentConfig(dataset=C.SYNTH_MNIST_HARD, users_count=19,
+                               mal_prop=mal, batch_size=64, epochs=30,
+                               defense=defense)
+        exp = FederatedExperiment(cfg, attacker=attack, dataset=ds)
+        for t in range(30):
+            exp.run_round(t)
+        _, c = exp.evaluate(exp.state.weights)
+        return 100.0 * float(c) / 2000
+
+    # NoDefense under the same attack collapses to ~15% (test_behavior.py);
+    # FLTrust holds ~81% at authoring time.
+    attacked = run("FLTrust", DriftAttack(0.5), 0.21)
+    assert attacked > 70.0
