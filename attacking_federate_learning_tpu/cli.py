@@ -65,6 +65,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="paper-faithful mode: faded lr on the server step "
                         "(the reference uses the constant base lr, "
                         "server.py:89)")
+    p.add_argument("--profile", action="store_true",
+                   help="accumulate per-phase (round/eval) wall-clock and "
+                        "record it in the JSONL log")
+    p.add_argument("--trace-dir", type=str, default=None,
+                   help="capture a jax.profiler XLA trace into this dir")
     return p
 
 
@@ -116,6 +121,9 @@ def main(argv=None):
     from attacking_federate_learning_tpu.data.datasets import load_dataset
     from attacking_federate_learning_tpu.utils.checkpoint import Checkpointer
     from attacking_federate_learning_tpu.utils.metrics import RunLogger
+    from attacking_federate_learning_tpu.utils.profiling import (
+        PhaseTimer, xla_trace
+    )
 
     logger = RunLogger(cfg, cfg.output, cfg.log_dir)
     logger.dump_config()
@@ -124,7 +132,11 @@ def main(argv=None):
     attacker = make_attacker(cfg, dataset=dataset)
     exp = FederatedExperiment(cfg, attacker=attacker, dataset=dataset)
     checkpointer = None if args.no_checkpoint else Checkpointer(cfg)
-    result = exp.run(logger, checkpointer=checkpointer)
+    timer = PhaseTimer() if args.profile else None
+    with xla_trace(args.trace_dir):
+        result = exp.run(logger, checkpointer=checkpointer, timer=timer)
+    if timer is not None:
+        logger.print({"phase_timing": timer.summary()})
     return result
 
 

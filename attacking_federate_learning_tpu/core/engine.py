@@ -208,11 +208,24 @@ class FederatedExperiment:
         return self.state
 
     def run(self, logger: Optional[RunLogger] = None,
-            checkpointer=None) -> dict:
-        """Full experiment loop (reference main.py:64-95)."""
+            checkpointer=None, timer=None) -> dict:
+        """Full experiment loop (reference main.py:64-95).
+
+        ``timer``: an optional utils.profiling.PhaseTimer; per-phase
+        wall-clock (round / eval, device-synchronized) is accumulated and
+        written as a structured record at the end (the reference's only
+        timing artifact is one timestamp, main.py:97)."""
+        import contextlib
+
         cfg = self.cfg
         logger = logger or RunLogger(cfg, cfg.output, cfg.log_dir)
         test_size = len(self.dataset.test_y)
+
+        def phase(name, sync=None):
+            if timer is None:
+                return contextlib.nullcontext()
+            return timer.phase(name,
+                               sync_on=sync or (lambda: self.state.weights))
 
         if cfg.backdoor:
             # Pre-training accuracy line (reference main.py:45-51).
@@ -225,10 +238,14 @@ class FederatedExperiment:
             logger.print("\nStarting Training...")
 
         for epoch in range(cfg.epochs):
-            self.run_round(epoch)
+            with phase("round"):
+                self.run_round(epoch)
 
             if epoch % cfg.test_step == 0 or epoch == cfg.epochs - 1:
-                test_loss, correct = self.evaluate(self.state.weights)
+                # The lambda reads `correct` after the block assigns it, so
+                # the timer blocks on the eval outputs, not stale state.
+                with phase("eval", lambda: correct):
+                    test_loss, correct = self.evaluate(self.state.weights)
                 accuracy = logger.record_eval(epoch, test_loss, correct,
                                               test_size)
                 if (accuracy > cfg.checkpoint_acc_threshold
@@ -242,6 +259,8 @@ class FederatedExperiment:
                     logger.record(kind="asr", round=epoch,
                                   attack_success_rate=float(asr))
 
+        if timer is not None:
+            logger.record(kind="profile", phases=timer.summary())
         logger.finish()
         return {"accuracies": logger.accuracies,
                 "epochs": logger.accuracies_epochs,

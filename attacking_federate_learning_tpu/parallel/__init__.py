@@ -1,2 +1,6 @@
 # Device-mesh / sharding layer (no reference analog: the reference has no
-# distributed backend, SURVEY.md §2.3).  Populated by parallel/mesh.py.
+# distributed backend, SURVEY.md §2.3).
+from attacking_federate_learning_tpu.parallel.mesh import (  # noqa: F401
+    CLIENTS, MODEL, MeshPlan, make_mesh, make_plan
+)
+from attacking_federate_learning_tpu.parallel import multihost  # noqa: F401

@@ -1,0 +1,63 @@
+"""Multi-host (DCN) initialization.
+
+The reference has no distributed communication backend at all (SURVEY.md
+§2.3: no NCCL/MPI/Gloo — its "broadcast" is a Python loop over objects in
+one process).  The TPU-native equivalent needs no bespoke transport either:
+``jax.distributed.initialize`` joins this process into a multi-host
+jax runtime, after which ``jax.devices()`` spans every host's chips, a
+single ``Mesh`` laid over them routes intra-slice collectives over ICI and
+cross-slice traffic over DCN, and every kernel in this framework
+(the Gram-matmul distances, the sharded sorts, the psum-style reductions
+XLA inserts) works unchanged.
+
+On a single host this module is a no-op, so the same experiment script runs
+anywhere:
+
+    from attacking_federate_learning_tpu.parallel import multihost
+    multihost.initialize()            # env-driven; no-op locally
+    plan = make_plan((jax.device_count(), 1))
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """Join the multi-host runtime; returns True if distributed mode is on.
+
+    With no arguments, reads the standard env vars
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID, or the
+    cluster autodetection jax.distributed supports on TPU pods).  Single
+    process with no coordinator configured -> no-op.
+    """
+    coordinator_address = (coordinator_address
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    num_processes = num_processes or _env_int("JAX_NUM_PROCESSES")
+    process_id = process_id if process_id is not None else _env_int(
+        "JAX_PROCESS_ID")
+
+    if coordinator_address is None and num_processes in (None, 1):
+        return False  # single-host: nothing to join
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def _env_int(name):
+    v = os.environ.get(name)
+    return int(v) if v is not None else None
+
+
+def is_primary() -> bool:
+    """True on the process that should write logs/checkpoints."""
+    return jax.process_index() == 0

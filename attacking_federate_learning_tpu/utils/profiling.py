@@ -26,12 +26,16 @@ class PhaseTimer:
 
     @contextlib.contextmanager
     def phase(self, name: str, sync_on=None):
+        """``sync_on``: array (or zero-arg callable returning one, evaluated
+        after the block so it can reference freshly produced state) to
+        block on before stopping the clock."""
         t0 = time.perf_counter()
         try:
             yield
         finally:
             if sync_on is not None:
-                jax.block_until_ready(sync_on)
+                jax.block_until_ready(sync_on() if callable(sync_on)
+                                      else sync_on)
             dt = time.perf_counter() - t0
             self.totals[name] += dt
             self.counts[name] += 1

@@ -112,6 +112,36 @@ def main():
     log(f"xla krum ({dev.platform}): {dev_ms:.2f} ms "
         f"(median of {REPEATS})")
 
+    # --- secondary: full FL round throughput (stderr diagnostic) --------
+    try:
+        from attacking_federate_learning_tpu.attacks import DriftAttack
+        from attacking_federate_learning_tpu.config import ExperimentConfig
+        from attacking_federate_learning_tpu.core.engine import (
+            FederatedExperiment
+        )
+        from attacking_federate_learning_tpu.data.datasets import load_dataset
+
+        for n_clients in (10, 512):
+            cfg = ExperimentConfig(
+                dataset="SYNTH_MNIST", users_count=n_clients,
+                mal_prop=0.24, batch_size=64, epochs=1, defense="Krum")
+            ds = load_dataset(cfg.dataset, seed=0, synth_train=8192,
+                              synth_test=512)
+            exp = FederatedExperiment(cfg, attacker=DriftAttack(1.5),
+                                      dataset=ds)
+            exp.run_round(0)  # compile
+            jax.block_until_ready(exp.state.weights)
+            t0 = time.perf_counter()
+            reps = 20
+            for t in range(1, reps + 1):
+                exp.run_round(t)
+            jax.block_until_ready(exp.state.weights)
+            rps = reps / (time.perf_counter() - t0)
+            log(f"fl_rounds_per_sec (Krum+ALIE, {n_clients} clients, "
+                f"mnist-mlp): {rps:.1f}")
+    except Exception as e:
+        log(f"round-throughput probe skipped: {type(e).__name__}: {e}")
+
     # --- north-star probe: 10k clients, TPU only (stderr) ---------------
     try:
         if not on_accel:

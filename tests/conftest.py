@@ -9,6 +9,36 @@ import os
 # makes the hook a no-op; tests are CPU-only by design.
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def hard_ds():
+    """Shared low-SNR behavioral dataset (generated once per session)."""
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+
+    return load_dataset(C.SYNTH_MNIST_HARD, seed=0, synth_train=8000,
+                        synth_test=2000)
+
+
+def hard_final_accuracy(ds, defense, attack, mal_prop, rounds=30):
+    """Run the standard behavioral config and return final test accuracy."""
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+
+    cfg = ExperimentConfig(dataset=C.SYNTH_MNIST_HARD, users_count=19,
+                           mal_prop=mal_prop, batch_size=64, epochs=rounds,
+                           defense=defense)
+    exp = FederatedExperiment(cfg, attacker=attack, dataset=ds)
+    for t in range(rounds):
+        exp.run_round(t)
+    _, correct = exp.evaluate(exp.state.weights)
+    return 100.0 * float(correct) / len(ds.test_y)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

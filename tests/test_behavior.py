@@ -31,24 +31,8 @@ from attacking_federate_learning_tpu.core.engine import FederatedExperiment
 from attacking_federate_learning_tpu.data.datasets import load_dataset
 
 
-ROUNDS = 30
-
-
-@pytest.fixture(scope="module")
-def hard_ds():
-    return load_dataset(C.SYNTH_MNIST_HARD, seed=0, synth_train=8000,
-                        synth_test=2000)
-
-
-def final_accuracy(ds, defense, attack, mal_prop, rounds=ROUNDS):
-    cfg = ExperimentConfig(dataset=C.SYNTH_MNIST_HARD, users_count=19,
-                           mal_prop=mal_prop, batch_size=64, epochs=rounds,
-                           defense=defense)
-    exp = FederatedExperiment(cfg, attacker=attack, dataset=ds)
-    for t in range(rounds):
-        exp.run_round(t)
-    _, correct = exp.evaluate(exp.state.weights)
-    return 100.0 * float(correct) / len(ds.test_y)
+# hard_ds fixture and the shared runner live in conftest.py.
+from conftest import hard_final_accuracy as final_accuracy  # noqa: E402
 
 
 def test_alie_defeats_plain_averaging(hard_ds):

@@ -28,23 +28,13 @@ def test_trust_weighted_average_rescales_to_server_norm():
     np.testing.assert_allclose(out, [2.0, 0.0], atol=1e-5)  # rescaled
 
 
-def test_fltrust_resists_alie_that_breaks_no_defense():
+def test_fltrust_resists_alie_that_breaks_no_defense(hard_ds):
     """ALIE z=0.5 collapses plain averaging (tests/test_behavior.py) but
     FLTrust's cosine gate keeps accuracy high."""
-    ds = load_dataset(C.SYNTH_MNIST_HARD, seed=0, synth_train=8000,
-                      synth_test=2000)
-
-    def run(defense, attack, mal):
-        cfg = ExperimentConfig(dataset=C.SYNTH_MNIST_HARD, users_count=19,
-                               mal_prop=mal, batch_size=64, epochs=30,
-                               defense=defense)
-        exp = FederatedExperiment(cfg, attacker=attack, dataset=ds)
-        for t in range(30):
-            exp.run_round(t)
-        _, c = exp.evaluate(exp.state.weights)
-        return 100.0 * float(c) / 2000
+    from conftest import hard_final_accuracy
 
     # NoDefense under the same attack collapses to ~15% (test_behavior.py);
     # FLTrust holds ~81% at authoring time.
-    attacked = run("FLTrust", DriftAttack(0.5), 0.21)
+    attacked = hard_final_accuracy(hard_ds, "FLTrust", DriftAttack(0.5),
+                                   0.21)
     assert attacked > 70.0

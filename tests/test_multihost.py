@@ -1,0 +1,13 @@
+"""Multi-host wrapper: single-host no-op semantics."""
+
+from attacking_federate_learning_tpu.parallel import multihost
+
+
+def test_single_host_is_noop(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert multihost.initialize() is False
+
+
+def test_is_primary_single_host():
+    assert multihost.is_primary() is True

@@ -1,0 +1,118 @@
+"""Cross-framework parity: the wire format and forward math must agree with
+a torch reconstruction of the reference architectures.
+
+The reference's entire data flow runs through flat parameter vectors of
+torch nets (reference user.py:17-28, data_sets.py:13-61).  Here we build the
+same architectures in torch (CPU), push ONE flat vector into both
+frameworks, and require the forward outputs to agree — proving a vector
+produced by the reference loads into this framework unchanged (and vice
+versa).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from attacking_federate_learning_tpu.models import get_model  # noqa: E402
+from attacking_federate_learning_tpu.utils.flatten import (  # noqa: E402
+    make_flattener
+)
+
+
+def load_flat_into_torch(flat_vec, torch_params):
+    """The reference's row_into_parameters semantics (user.py:21-28)."""
+    offset = 0
+    for p in torch_params:
+        size = int(np.prod(p.shape))
+        chunk = flat_vec[offset: offset + size].reshape(tuple(p.shape))
+        with torch.no_grad():
+            p.copy_(torch.from_numpy(np.ascontiguousarray(chunk)))
+        offset += size
+    assert offset == len(flat_vec)
+
+
+def build_torch_mnist():
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    class Net(nn.Module):
+        # Same architecture as reference MnistNet (data_sets.py:13-23).
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(28 * 28, 100)
+            self.fc2 = nn.Linear(100, 10)
+
+        def forward(self, x):
+            return F.log_softmax(self.fc2(F.relu(self.fc1(x))), dim=1)
+
+    return Net()
+
+
+def build_torch_cifar10():
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    class Net(nn.Module):
+        # Same architecture as reference Cifar10Net (data_sets.py:33-52).
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(3, 16, 3)
+            self.pool1 = nn.MaxPool2d(3)
+            self.conv2 = nn.Conv2d(16, 64, 4)
+            self.pool2 = nn.MaxPool2d(4)
+            self.fc1 = nn.Linear(64, 384)
+            self.fc2 = nn.Linear(384, 192)
+            self.fc3 = nn.Linear(192, 10)
+
+        def forward(self, x):
+            x = self.pool1(F.relu(self.conv1(x)))
+            x = self.pool2(F.relu(self.conv2(x)))
+            x = x.view(x.size(0), -1)
+            x = F.relu(self.fc1(x))
+            x = F.relu(self.fc2(x))
+            return F.log_softmax(self.fc3(x), dim=1)
+
+    return Net()
+
+
+@pytest.mark.parametrize("name,builder,in_shape", [
+    ("mnist_mlp", build_torch_mnist, (4, 784)),
+    ("cifar10_cnn", build_torch_cifar10, (4, 3, 32, 32)),
+])
+def test_same_flat_vector_same_forward(name, builder, in_shape):
+    model = get_model(name)
+    params = model.init(jax.random.key(0))
+    flat = make_flattener(params)
+    vec = np.asarray(flat.ravel(params))
+
+    tnet = builder()
+    load_flat_into_torch(vec, tnet.parameters())
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(in_shape).astype(np.float32)
+
+    ours = np.asarray(model.apply(flat.unravel(jnp.asarray(vec)), jnp.asarray(x)))
+    with torch.no_grad():
+        theirs = tnet(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-5, rtol=1e-4)
+
+
+def test_torch_flat_vector_roundtrips_through_wire():
+    """A torch-initialized net's flat vector (reference flatten_params,
+    user.py:17-18) loads into our model and returns identical params."""
+    tnet = build_torch_mnist()
+    vec = np.concatenate([p.detach().numpy().ravel()
+                          for p in tnet.parameters()])
+    model = get_model("mnist_mlp")
+    flat = make_flattener(model.init(jax.random.key(1)))
+    params = flat.unravel(jnp.asarray(vec))
+    np.testing.assert_array_equal(
+        np.asarray(params["fc1"]["weight"]),
+        tnet.fc1.weight.detach().numpy())
+    np.testing.assert_array_equal(
+        np.asarray(params["fc2"]["bias"]),
+        tnet.fc2.bias.detach().numpy())
