@@ -4,8 +4,8 @@ Flag-compatible with the reference driver (reference main.py:103-153),
 including short flags and defaults (-m 0.24, -z 1.5, -d NoDefense, -s MNIST,
 -b No, -c 128, -e 300, -l 0.1), minus its typo'd ``-dispatch_weightsn`` alias
 for --users-count (main.py:118) and plus the TPU-era knobs: --backend,
---partition, --seed, --server-uses-faded-lr.  CIFAR100 is intentionally not
-offered yet, mirroring the reference CLI's own exclusion (main.py:114).
+--partition, --seed, --server-uses-faded-lr.  Unlike the reference CLI
+(main.py:114), CIFAR100/WRN-40-4 is selectable here.
 
 Run:  python -m attacking_federate_learning_tpu.cli -d Krum -s MNIST
 
@@ -33,8 +33,11 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["NoDefense", "Bulyan", "TrimmedMean", "Krum",
                             "FLTrust"])
     p.add_argument("-s", "--dataset", default=C.MNIST,
-                   choices=[C.MNIST, C.CIFAR10, C.SYNTH_MNIST,
-                            C.SYNTH_CIFAR10, C.SYNTH_MNIST_HARD])
+                   choices=[C.MNIST, C.CIFAR10, C.CIFAR100, C.SYNTH_MNIST,
+                            C.SYNTH_CIFAR10, C.SYNTH_MNIST_HARD],
+                   help="CIFAR100 runs the WRN-40-4 the reference defines "
+                        "but never exposes (reference main.py:114 excludes "
+                        "it; data_sets.py:108-173 defines it)")
     p.add_argument("-b", "--backdoor", default="No",
                    choices=["No", "pattern", "1", "2", "3"],
                    help="no backdoor, pattern trigger, or single-sample "
@@ -50,6 +53,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dirichlet-alpha", default=0.5, type=float)
     p.add_argument("--seed", default=0, type=int)
     p.add_argument("--data-dir", default="data", type=str)
+    p.add_argument("--synth-train", default=10000, type=int,
+                   help="training examples for SYNTH_* / fallback datasets")
+    p.add_argument("--synth-test", default=2000, type=int,
+                   help="test examples for SYNTH_* / fallback datasets")
     p.add_argument("--backend", default="auto",
                    choices=["auto", "cpu", "tpu"],
                    help="JAX platform; must be chosen before jax initializes")
@@ -65,9 +72,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="paper-faithful mode: faded lr on the server step "
                         "(the reference uses the constant base lr, "
                         "server.py:89)")
+    p.add_argument("--resume", nargs="?", const="auto", default=None,
+                   metavar="CKPT",
+                   help="resume from a checkpoint (.npz path, or no value "
+                        "to use runs/<dataset>/checkpoint.npz); continues "
+                        "from the saved round")
     p.add_argument("--profile", action="store_true",
                    help="accumulate per-phase (round/eval) wall-clock and "
                         "record it in the JSONL log")
+    p.add_argument("--round-stats", action="store_true",
+                   help="record per-round gradient/update norm diagnostics "
+                        "in the JSONL log")
     p.add_argument("--trace-dir", type=str, default=None,
                    help="capture a jax.profiler XLA trace into this dir")
     return p
@@ -95,6 +110,9 @@ def config_from_args(args) -> ExperimentConfig:
         mesh_shape=mesh_shape,
         krum_paper_scoring=args.krum_paper_scoring,
         server_uses_faded_lr=args.server_uses_faded_lr,
+        log_round_stats=args.round_stats,
+        synth_train=args.synth_train,
+        synth_test=args.synth_test,
     )
 
 
@@ -128,10 +146,29 @@ def main(argv=None):
     logger = RunLogger(cfg, cfg.output, cfg.log_dir)
     logger.dump_config()
 
-    dataset = load_dataset(cfg.dataset, cfg.data_dir, cfg.seed)
+    dataset = load_dataset(cfg.dataset, cfg.data_dir, cfg.seed,
+                           synth_train=cfg.synth_train,
+                           synth_test=cfg.synth_test)
     attacker = make_attacker(cfg, dataset=dataset)
     exp = FederatedExperiment(cfg, attacker=attacker, dataset=dataset)
     checkpointer = None if args.no_checkpoint else Checkpointer(cfg)
+    if args.resume is not None:
+        import numpy as np
+
+        ckpt = checkpointer or Checkpointer(cfg)
+        path = args.resume if args.resume != "auto" else ckpt.path
+        if not os.path.exists(path):
+            raise SystemExit(f"--resume: no checkpoint at {path}")
+        exp.state = ckpt.resume(path)
+        if exp.shardings is not None:
+            # Restore the planned state sharding the engine set at init.
+            _, _, _, exp.state = exp.shardings.place(
+                exp.shards, exp.train_x, exp.train_y, exp.state)
+        if checkpointer is not None:
+            # Don't let the first post-resume eval overwrite a better
+            # checkpoint (keep_best seeding).
+            checkpointer.best_acc = float(np.load(path)["accuracy"])
+        logger.print(f"Resumed from round {int(exp.state.round)}")
     timer = PhaseTimer() if args.profile else None
     with xla_trace(args.trace_dir):
         result = exp.run(logger, checkpointer=checkpointer, timer=timer)

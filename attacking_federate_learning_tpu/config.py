@@ -73,6 +73,12 @@ class ExperimentConfig:
     # Here every random choice flows from this seed (SURVEY.md §2.4 #13).
     seed: int = 0
 
+    # --- synthetic dataset sizing (SYNTH_* / air-gapped fallbacks) ------
+    # Part of the config (not a CLI side-channel) so checkpoints record
+    # them and --resume rebuilds the identical dataset.
+    synth_train: int = 10000
+    synth_test: int = 2000
+
     # --- data partition -------------------------------------------------
     partition: str = "iid"           # 'iid' (DistributedSampler-equivalent,
                                      # reference user.py:49-54) | 'dirichlet'
@@ -100,6 +106,12 @@ class ExperimentConfig:
     # --- metadata subsystem (reference C12, vestigial there) ------------
     collect_metadata: bool = False
     metadata_fraction: float = 0.11  # reference user.py:65 test_size=0.11
+
+    # --- observability --------------------------------------------------
+    # Per-round structured diagnostics (gradient-norm stats, aggregate
+    # norm, faded lr) written to the JSONL log.  The reference logs only
+    # eval-time accuracy (SURVEY.md §5).
+    log_round_stats: bool = False
 
     def __post_init__(self):
         if self.fading_rate is None:

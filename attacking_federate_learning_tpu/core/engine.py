@@ -179,11 +179,29 @@ class FederatedExperiment:
                 learning_rate=faded_learning_rate(
                     cfg.learning_rate, cfg.fading_rate, t))
 
+        def round_diagnostics(grads, state_after, t):
+            """Per-round stats (SURVEY.md §5 rebuild item): client gradient
+            norm spread, aggregate step norm, faded lr."""
+            norms = jnp.linalg.norm(grads.astype(jnp.float32), axis=1)
+            return {
+                "grad_norm_mean": jnp.mean(norms),
+                "grad_norm_max": jnp.max(norms),
+                "grad_norm_min": jnp.min(norms),
+                "update_norm": jnp.linalg.norm(state_after.velocity),
+                "faded_lr": faded_learning_rate(cfg.learning_rate,
+                                                cfg.fading_rate, t),
+            }
+
+        self._round_diagnostics = round_diagnostics
+
         if getattr(self.attacker, "fusable", True):
             def fused(state, t):
                 grads = self._compute_grads_impl(state, t)
                 grads = self.attacker.apply(grads, self.f, ctx_for(state, t))
-                return self._aggregate_impl(state, grads, t)
+                new_state = self._aggregate_impl(state, grads, t)
+                diag = (round_diagnostics(grads, new_state, t)
+                        if cfg.log_round_stats else {})
+                return new_state, diag
 
             self._fused_round = jax.jit(fused, donate_argnums=0)
             self._staged = False
@@ -195,8 +213,11 @@ class FederatedExperiment:
     # ------------------------------------------------------------------
     def run_round(self, t: int) -> ServerState:
         t = jnp.asarray(t, jnp.int32)
+        self.last_round_stats = None
         if not self._staged:
-            self.state = self._fused_round(self.state, t)
+            self.state, diag = self._fused_round(self.state, t)
+            if diag:
+                self.last_round_stats = diag
         else:
             grads = self._compute_grads(self.state, t)
             ctx = AttackContext(
@@ -205,6 +226,9 @@ class FederatedExperiment:
                     self.cfg.learning_rate, self.cfg.fading_rate, t))
             grads = self.attacker.apply(grads, self.f, ctx)
             self.state = self._aggregate(self.state, grads, t)
+            if self.cfg.log_round_stats:
+                self.last_round_stats = self._round_diagnostics(
+                    grads, self.state, t)
         return self.state
 
     def run(self, logger: Optional[RunLogger] = None,
@@ -237,9 +261,15 @@ class FederatedExperiment:
         else:
             logger.print("\nStarting Training...")
 
-        for epoch in range(cfg.epochs):
+        # Resume-aware: a restored ServerState carries its round counter
+        # (utils/checkpoint.py), so the loop continues where it stopped.
+        for epoch in range(int(self.state.round), cfg.epochs):
             with phase("round"):
                 self.run_round(epoch)
+            if cfg.log_round_stats and self.last_round_stats is not None:
+                logger.record(kind="round", round=epoch,
+                              **{k: float(v) for k, v in
+                                 self.last_round_stats.items()})
 
             if epoch % cfg.test_step == 0 or epoch == cfg.epochs - 1:
                 # The lambda reads `correct` after the block assigns it, so

@@ -47,34 +47,68 @@ def no_defense(users_grads, users_count, corrupted_count):
 
 
 def _krum_scores(D, users_count, corrupted_count, alive=None,
-                 paper_scoring=False):
+                 paper_scoring=False, method="sort"):
     """Per-user Krum score: sum of the k smallest distances to other
     (alive) users.  Reference behavior sums k = users_count -
     corrupted_count (reference defences.py:26, 33-34; note the reference
     dict holds no self-distance, which the +inf diagonal reproduces);
     ``paper_scoring`` switches to the NIPS'17 paper's k = n - f - 2
-    (SURVEY.md §2.4 #4)."""
+    (SURVEY.md §2.4 #4).
+
+    Two exact evaluation strategies:
+    - 'sort': full ascending sort per row + masked prefix sum.
+    - 'topk': complement identity.  A row always has exactly k + c
+      participating entries where c = f - 1 (+2 under paper scoring) is
+      *independent of Bulyan's shrinking pool*, so
+      sum-of-k-smallest = rowsum - sum-of-c-largest, and ``lax.top_k`` of
+      the small complement replaces the O(n log n)-per-row sort.
+    - 'auto': 'topk' when the complement is small relative to n.
+
+    Default is 'sort' — the oracle-verified path.  'topk' is numerically a
+    subtraction and can lose precision when adversarial gradients make the
+    rowsum huge; opt in (or use 'auto') for the large-n/small-f regime
+    after checking tolerance for your threat model.
+    """
     n = D.shape[0]
-    Dm = D + jnp.diag(jnp.full((n,), _INF, D.dtype))
-    if alive is not None:
-        row_dead = jnp.where(alive, 0.0, _INF)
-        Dm = Dm + row_dead[None, :] + row_dead[:, None]
-    k = users_count - corrupted_count - (2 if paper_scoring else 0)
-    srt = jnp.sort(Dm, axis=1)  # ascending; masked/self entries land last
-    prefix = (jnp.arange(n) < k) & jnp.isfinite(srt)
-    scores = jnp.sum(jnp.where(prefix, srt, 0.0), axis=1)
+    # entries per row = pool - 1, k = pool - f (- 2 paper) -> complement is
+    # pool-independent: f - 1 (+ 2 under paper scoring).
+    complement = corrupted_count - 1 + (2 if paper_scoring else 0)
+    if method == "auto":
+        method = "topk" if (0 <= complement <= max(n // 4, 1)) else "sort"
+
+    if method == "topk" and complement >= 0:
+        pair_alive = None
+        if alive is not None:
+            pair_alive = alive[None, :] & alive[:, None]
+        mask = ~jnp.eye(n, dtype=bool) if pair_alive is None else (
+            pair_alive & ~jnp.eye(n, dtype=bool))
+        rowsum = jnp.sum(jnp.where(mask, D, 0.0), axis=1)
+        if complement > 0:
+            top, _ = lax.top_k(jnp.where(mask, D, -_INF), complement)
+            rowsum = rowsum - jnp.sum(jnp.maximum(top, 0.0), axis=1)
+        scores = rowsum
+    else:
+        Dm = D + jnp.diag(jnp.full((n,), _INF, D.dtype))
+        if alive is not None:
+            row_dead = jnp.where(alive, 0.0, _INF)
+            Dm = Dm + row_dead[None, :] + row_dead[:, None]
+        k = users_count - corrupted_count - (2 if paper_scoring else 0)
+        srt = jnp.sort(Dm, axis=1)  # ascending; masked entries land last
+        prefix = (jnp.arange(n) < k) & jnp.isfinite(srt)
+        scores = jnp.sum(jnp.where(prefix, srt, 0.0), axis=1)
     if alive is not None:
         scores = jnp.where(alive, scores, _INF)
     return scores
 
 
 @DEFENSES.register("Krum")
-def krum(users_grads, users_count, corrupted_count, paper_scoring=False):
+def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
+         method="sort"):
     """Krum selection (reference defences.py:23-42): the single gradient
     whose summed distance to its k nearest peers is minimal."""
     D = pairwise_distances(users_grads)
     scores = _krum_scores(D, users_count, corrupted_count,
-                          paper_scoring=paper_scoring)
+                          paper_scoring=paper_scoring, method=method)
     return users_grads[jnp.argmin(scores)]
 
 
@@ -101,7 +135,8 @@ def trimmed_mean(users_grads, users_count, corrupted_count):
 
 
 @DEFENSES.register("Bulyan")
-def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False):
+def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
+           method="sort"):
     """Bulyan (reference defences.py:55-70): iteratively Krum-select
     n - 2f gradients (removing each winner from the pool, with the pool
     size — but not f — shrinking), then trim-mean the selection with
@@ -114,7 +149,7 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False):
     def body(t, carry):
         alive, selected = carry
         scores = _krum_scores(D, users_count - t, f, alive=alive,
-                              paper_scoring=paper_scoring)
+                              paper_scoring=paper_scoring, method=method)
         idx = jnp.argmin(scores)
         return alive.at[idx].set(False), selected.at[t].set(idx)
 

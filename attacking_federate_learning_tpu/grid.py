@@ -37,7 +37,9 @@ def run_grid(base: ExperimentConfig, defenses=None, attacks=None,
 
     defenses = defenses or DEFENSES_ALL
     attacks = attacks or ATTACKS_ALL
-    dataset = load_dataset(base.dataset, base.data_dir, base.seed)
+    dataset = load_dataset(base.dataset, base.data_dir, base.seed,
+                           synth_train=base.synth_train,
+                           synth_test=base.synth_test)
     os.makedirs(base.log_dir, exist_ok=True)
     out_path = out_path or os.path.join(base.log_dir, "grid_summary.jsonl")
     results = []

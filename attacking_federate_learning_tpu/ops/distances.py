@@ -19,15 +19,33 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def pairwise_sq_distances(G, precision=lax.Precision.HIGHEST):
-    """(n, d) -> (n, n) squared Euclidean distance matrix."""
-    sq = jnp.sum(G * G, axis=-1)
-    gram = jnp.matmul(G, G.T, precision=precision)
-    d2 = sq[:, None] + sq[None, :] - 2.0 * gram
+def cross_sq_distances(A, B, precision=None):
+    """(m, d), (n, d) -> (m, n) squared Euclidean distances in f32.
+
+    f32 inputs use HIGHEST matmul precision (parity with the reference's
+    float math); bf16 inputs ride the MXU at native precision with f32
+    accumulation (``preferred_element_type``) and f32 squared norms — the
+    large-n memory/speed mode (config.grad_dtype='bfloat16').  Shared by
+    the single-device kernel and the blockwise shard_map tiles
+    (parallel/distances.py) so every path computes identical values.
+    """
+    if precision is None:
+        precision = (lax.Precision.DEFAULT if A.dtype == jnp.bfloat16
+                     else lax.Precision.HIGHEST)
+    sq_a = jnp.sum(A.astype(jnp.float32) * A.astype(jnp.float32), axis=-1)
+    sq_b = jnp.sum(B.astype(jnp.float32) * B.astype(jnp.float32), axis=-1)
+    gram = jnp.matmul(A, B.T, precision=precision,
+                      preferred_element_type=jnp.float32)
+    d2 = sq_a[:, None] + sq_b[None, :] - 2.0 * gram
     return jnp.maximum(d2, 0.0)
 
 
-def pairwise_distances(G, precision=lax.Precision.HIGHEST):
+def pairwise_sq_distances(G, precision=None):
+    """(n, d) -> (n, n) squared Euclidean distance matrix in f32."""
+    return cross_sq_distances(G, G, precision)
+
+
+def pairwise_distances(G, precision=None):
     """(n, d) -> (n, n) Euclidean distance matrix, zero diagonal."""
     D = jnp.sqrt(pairwise_sq_distances(G, precision))
     # Exact zeros on the diagonal (the matmul identity can leave ~1e-4 noise).

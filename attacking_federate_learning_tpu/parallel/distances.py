@@ -26,14 +26,14 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from attacking_federate_learning_tpu.ops.distances import cross_sq_distances
 from attacking_federate_learning_tpu.parallel.mesh import CLIENTS
 
 
-def _tile(a_blk, b_blk, precision=lax.Precision.HIGHEST):
-    sq_a = jnp.sum(a_blk * a_blk, axis=-1)
-    sq_b = jnp.sum(b_blk * b_blk, axis=-1)
-    gram = jnp.matmul(a_blk, b_blk.T, precision=precision)
-    return jnp.maximum(sq_a[:, None] + sq_b[None, :] - 2.0 * gram, 0.0)
+def _tile(a_blk, b_blk):
+    # Shared math with the single-device kernel (incl. the bf16 f32-accum
+    # policy) so blockwise results match it exactly.
+    return cross_sq_distances(a_blk, b_blk)
 
 
 def pairwise_distances_allgather(G, mesh, axis=CLIENTS):

@@ -9,6 +9,12 @@ import os
 # makes the hook a no-op; tests are CPU-only by design.
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Persistent compile cache: the suite compiles dozens of kernel variants and
+# this box has one core — caching cuts re-runs from minutes to seconds.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(__file__), os.pardir,
+                                   ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 import pytest  # noqa: E402
 

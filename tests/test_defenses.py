@@ -114,3 +114,34 @@ def test_krum_paper_scoring_flag():
         scores.append(others[: 15 - 3 - 2].sum())
     want = G[int(np.argmin(scores))]
     np.testing.assert_allclose(paper_out, want, atol=2e-4)
+
+
+@pytest.mark.parametrize("name", ["Krum", "Bulyan"])
+@pytest.mark.parametrize("n,d,f", [(11, 30, 2), (23, 104, 5), (40, 33, 9)])
+def test_topk_and_sort_scoring_agree(name, n, d, f):
+    """The complement-top_k evaluation (sum-of-k-smallest = rowsum minus
+    sum-of-(f-1)-largest) must match the full-sort path exactly."""
+    G = jnp.asarray(grads_for(n, d, seed=n + d + f))
+    a = np.asarray(K.DEFENSES[name](G, n, f, method="sort"))
+    b = np.asarray(K.DEFENSES[name](G, n, f, method="topk"))
+    np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_bf16_grads_accepted():
+    """bf16 gradient matrix rides the distance kernel with f32 accumulation
+    and still selects a sensible Krum winner."""
+    G = grads_for(15, 64, seed=11)
+    G[0] += 50.0  # gross outlier
+    out = np.asarray(K.krum(jnp.asarray(G, jnp.bfloat16), 15, 3))
+    assert not np.allclose(out.astype(np.float32), G[0], atol=1.0)
+
+
+def test_topk_scoring_with_adversarial_magnitudes():
+    """Complement subtraction under huge-norm Byzantine rows must still
+    select the same gradient as the sort path (documents the numerical
+    envelope of method='topk')."""
+    G = grads_for(21, 50, seed=13)
+    G[:4] *= 1e4  # gross-magnitude attackers
+    a = np.asarray(K.krum(jnp.asarray(G), 21, 4, method="sort"))
+    b = np.asarray(K.krum(jnp.asarray(G), 21, 4, method="topk"))
+    np.testing.assert_allclose(a, b, atol=1e-5)

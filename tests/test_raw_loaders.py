@@ -1,0 +1,127 @@
+"""Raw dataset-file loaders (IDX / CIFAR pickles) against generated files.
+
+The box has no real datasets (zero egress), so these tests write miniature
+files in the exact on-disk formats — MNIST IDX magic/dims/uint8 payload,
+CIFAR python pickles with bytes keys — and check parsing, shapes and the
+reference normalizations (data_sets.py:26-27, :56-57, :154-155).
+"""
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from attacking_federate_learning_tpu.data import datasets as D
+
+
+def write_idx_images(path, arr):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000803))
+        f.write(struct.pack(">III", *arr.shape))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+def write_idx_labels(path, arr):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000801))
+        f.write(struct.pack(">I", arr.shape[0]))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+def test_mnist_idx_loader(tmp_path):
+    rng = np.random.default_rng(0)
+    tx = rng.integers(0, 256, (20, 28, 28))
+    ty = rng.integers(0, 10, 20)
+    vx = rng.integers(0, 256, (8, 28, 28))
+    vy = rng.integers(0, 10, 8)
+    d = tmp_path
+    write_idx_images(d / "train-images-idx3-ubyte", tx)
+    write_idx_labels(d / "train-labels-idx1-ubyte", ty)
+    write_idx_images(d / "t10k-images-idx3-ubyte", vx)
+    write_idx_labels(d / "t10k-labels-idx1-ubyte", vy)
+
+    ds = D.load_mnist(str(d))
+    assert ds.train_x.shape == (20, 1, 28, 28)
+    assert ds.test_x.shape == (8, 1, 28, 28)
+    np.testing.assert_array_equal(ds.train_y, ty.astype(np.int32))
+    # Reference normalization (x/255 - 0.1307) / 0.3081.
+    want = (tx[0].astype(np.float32) / 255.0 - 0.1307) / 0.3081
+    np.testing.assert_allclose(ds.train_x[0, 0], want, atol=1e-6)
+
+
+def test_mnist_idx_gzip_variant(tmp_path):
+    rng = np.random.default_rng(1)
+    for name, writer, arr in [
+        ("train-images-idx3-ubyte", write_idx_images,
+         rng.integers(0, 256, (4, 28, 28))),
+        ("train-labels-idx1-ubyte", write_idx_labels,
+         rng.integers(0, 10, 4)),
+        ("t10k-images-idx3-ubyte", write_idx_images,
+         rng.integers(0, 256, (2, 28, 28))),
+        ("t10k-labels-idx1-ubyte", write_idx_labels,
+         rng.integers(0, 10, 2)),
+    ]:
+        raw = tmp_path / (name + ".raw")
+        writer(raw, arr)
+        with open(raw, "rb") as f, gzip.open(
+                str(tmp_path / (name + ".gz")), "wb") as g:
+            g.write(f.read())
+        os.remove(raw)
+
+    ds = D.load_mnist(str(tmp_path))
+    assert ds.train_x.shape == (4, 1, 28, 28)
+
+
+def test_cifar10_pickle_loader(tmp_path):
+    rng = np.random.default_rng(2)
+    d = tmp_path / "cifar-10-batches-py"
+    d.mkdir()
+    for i in range(1, 6):
+        batch = {b"data": rng.integers(0, 256, (10, 3072),
+                                       dtype=np.uint8).astype(np.uint8),
+                 b"labels": rng.integers(0, 10, 10).tolist()}
+        with open(d / f"data_batch_{i}", "wb") as f:
+            pickle.dump(batch, f)
+    test_batch = {b"data": rng.integers(0, 256, (6, 3072), dtype=np.uint8),
+                  b"labels": rng.integers(0, 10, 6).tolist()}
+    with open(d / "test_batch", "wb") as f:
+        pickle.dump(test_batch, f)
+
+    ds = D.load_cifar10(str(tmp_path))
+    assert ds.train_x.shape == (50, 3, 32, 32)
+    assert ds.test_x.shape == (6, 3, 32, 32)
+    # Reference normalization (x/255 - 0.5) / 0.5 in [-1, 1].
+    assert ds.train_x.min() >= -1.0 and ds.train_x.max() <= 1.0
+
+
+def test_cifar100_pickle_loader(tmp_path):
+    rng = np.random.default_rng(3)
+    d = tmp_path / "cifar-100-python"
+    d.mkdir()
+    for name, n in [("train", 12), ("test", 5)]:
+        batch = {b"data": rng.integers(0, 256, (n, 3072), dtype=np.uint8),
+                 b"fine_labels": rng.integers(0, 100, n).tolist()}
+        with open(d / name, "wb") as f:
+            pickle.dump(batch, f)
+
+    ds = D.load_cifar100(str(tmp_path))
+    assert ds.train_x.shape == (12, 3, 32, 32)
+    assert ds.num_classes == 100
+
+
+def test_load_dataset_prefers_real_files(tmp_path):
+    """When raw files exist, MNIST loads them instead of falling back."""
+    rng = np.random.default_rng(4)
+    write_idx_images(tmp_path / "train-images-idx3-ubyte",
+                     rng.integers(0, 256, (4, 28, 28)))
+    write_idx_labels(tmp_path / "train-labels-idx1-ubyte",
+                     rng.integers(0, 10, 4))
+    write_idx_images(tmp_path / "t10k-images-idx3-ubyte",
+                     rng.integers(0, 256, (2, 28, 28)))
+    write_idx_labels(tmp_path / "t10k-labels-idx1-ubyte",
+                     rng.integers(0, 10, 2))
+    ds = D.load_dataset("MNIST", data_dir=str(tmp_path))
+    assert ds.name == "MNIST"
+    assert len(ds.train_y) == 4
