@@ -21,6 +21,16 @@ def _make_backdoor(cfg, dataset=None):
 
 ATTACKS.register("backdoor", _make_backdoor)
 
+from attacking_federate_learning_tpu.attacks.baselines import (  # noqa: E402
+    GaussianNoiseAttack, SignFlipAttack
+)
+
+ATTACKS.register("signflip",
+                 lambda cfg, dataset=None: SignFlipAttack(cfg.num_std))
+ATTACKS.register("noise",
+                 lambda cfg, dataset=None: GaussianNoiseAttack(
+                     cfg.num_std, seed=cfg.seed))
+
 
 def make_attacker(cfg, dataset=None, name=None):
     """Attack selection mirroring reference main.py:44-54: a backdoor option

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 class AttackContext(NamedTuple):
     original_params: jax.Array   # (d,) weights broadcast this round
     learning_rate: jax.Array     # faded lr (reference server.py:50-52)
+    round: jax.Array = 0         # () int32 round index (rng derivation)
 
 
 def cohort_stats(mal_grads):

@@ -31,7 +31,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="how many standard deviations the attacker shifts")
     p.add_argument("-d", "--defense", default="NoDefense",
                    choices=["NoDefense", "Bulyan", "TrimmedMean", "Krum",
-                            "FLTrust"])
+                            "FLTrust", "Median"])
     p.add_argument("-s", "--dataset", default=C.MNIST,
                    choices=[C.MNIST, C.CIFAR10, C.CIFAR100, C.SYNTH_MNIST,
                             C.SYNTH_CIFAR10, C.SYNTH_MNIST_HARD],

@@ -177,7 +177,10 @@ class FederatedExperiment:
             return AttackContext(
                 original_params=state.weights,
                 learning_rate=faded_learning_rate(
-                    cfg.learning_rate, cfg.fading_rate, t))
+                    cfg.learning_rate, cfg.fading_rate, t),
+                round=t)
+
+        self._ctx_for = ctx_for  # single construction site for the seam
 
         def round_diagnostics(grads, state_after, t):
             """Per-round stats (SURVEY.md §5 rebuild item): client gradient
@@ -195,15 +198,31 @@ class FederatedExperiment:
         self._round_diagnostics = round_diagnostics
 
         if getattr(self.attacker, "fusable", True):
-            def fused(state, t):
+            def fused_core(state, t):
                 grads = self._compute_grads_impl(state, t)
                 grads = self.attacker.apply(grads, self.f, ctx_for(state, t))
-                new_state = self._aggregate_impl(state, grads, t)
+                return self._aggregate_impl(state, grads, t), grads
+
+            def fused(state, t):
+                new_state, grads = fused_core(state, t)
                 diag = (round_diagnostics(grads, new_state, t)
                         if cfg.log_round_stats else {})
                 return new_state, diag
 
+            def fused_span(state, t0, count):
+                # One device program for `count` rounds: steady-state
+                # training between evals never returns to the host
+                # (the reference makes 3N+2 host->object calls per round,
+                # main.py:66-71).  count is a traced operand (fori_loop),
+                # so every span length shares one compilation.
+                def body(i, s):
+                    s2, _ = fused_core(s, t0 + i)
+                    return s2
+
+                return jax.lax.fori_loop(0, count, body, state)
+
             self._fused_round = jax.jit(fused, donate_argnums=0)
+            self._fused_span = jax.jit(fused_span, donate_argnums=0)
             self._staged = False
         else:
             self._compute_grads = jax.jit(self._compute_grads_impl)
@@ -211,6 +230,24 @@ class FederatedExperiment:
             self._staged = True
 
     # ------------------------------------------------------------------
+    def run_span(self, start: int, count: int) -> ServerState:
+        """Run ``count`` rounds [start, start+count) as one scanned device
+        program when the attack is fusable; falls back to per-round calls
+        otherwise."""
+        if count <= 0:
+            return self.state
+        if self._staged or self.cfg.log_round_stats:
+            # Per-round path: staged attacks need host crafting; round
+            # diagnostics need every intermediate gradient matrix.
+            for t in range(start, start + count):
+                self.run_round(t)
+        else:
+            self.last_round_stats = None
+            self.state = self._fused_span(
+                self.state, jnp.asarray(start, jnp.int32),
+                jnp.asarray(count, jnp.int32))
+        return self.state
+
     def run_round(self, t: int) -> ServerState:
         t = jnp.asarray(t, jnp.int32)
         self.last_round_stats = None
@@ -220,11 +257,8 @@ class FederatedExperiment:
                 self.last_round_stats = diag
         else:
             grads = self._compute_grads(self.state, t)
-            ctx = AttackContext(
-                original_params=self.state.weights,
-                learning_rate=faded_learning_rate(
-                    self.cfg.learning_rate, self.cfg.fading_rate, t))
-            grads = self.attacker.apply(grads, self.f, ctx)
+            grads = self.attacker.apply(grads, self.f,
+                                        self._ctx_for(self.state, t))
             self.state = self._aggregate(self.state, grads, t)
             if self.cfg.log_round_stats:
                 self.last_round_stats = self._round_diagnostics(
@@ -263,13 +297,29 @@ class FederatedExperiment:
 
         # Resume-aware: a restored ServerState carries its round counter
         # (utils/checkpoint.py), so the loop continues where it stopped.
-        for epoch in range(int(self.state.round), cfg.epochs):
-            with phase("round"):
-                self.run_round(epoch)
-            if cfg.log_round_stats and self.last_round_stats is not None:
-                logger.record(kind="round", round=epoch,
-                              **{k: float(v) for k, v in
-                                 self.last_round_stats.items()})
+        # When the attack is fusable and no per-round observability is
+        # requested, all rounds between eval points run as ONE scanned
+        # device program (run_span); eval cadence is identical either way.
+        use_spans = (not self._staged and not cfg.log_round_stats
+                     and timer is None)
+        epoch = int(self.state.round)
+        while epoch < cfg.epochs:
+            if use_spans:
+                # Advance to the next eval boundary in one device program.
+                if epoch % cfg.test_step == 0:
+                    boundary = epoch
+                else:
+                    boundary = min((epoch // cfg.test_step + 1)
+                                   * cfg.test_step, cfg.epochs - 1)
+                self.run_span(epoch, boundary - epoch + 1)
+                epoch = boundary
+            else:
+                with phase("round"):
+                    self.run_round(epoch)
+                if cfg.log_round_stats and self.last_round_stats is not None:
+                    logger.record(kind="round", round=epoch,
+                                  **{k: float(v) for k, v in
+                                     self.last_round_stats.items()})
 
             if epoch % cfg.test_step == 0 or epoch == cfg.epochs - 1:
                 # The lambda reads `correct` after the block assigns it, so
@@ -288,6 +338,7 @@ class FederatedExperiment:
                                                  logger=logger, tag="POST")
                     logger.record(kind="asr", round=epoch,
                                   attack_success_rate=float(asr))
+            epoch += 1
 
         if timer is not None:
             logger.record(kind="profile", phases=timer.summary())

@@ -2,3 +2,4 @@ from attacking_federate_learning_tpu.defenses.kernels import (  # noqa: F401
     DEFENSES, bulyan, check_defense_args, krum, no_defense, trimmed_mean
 )
 from attacking_federate_learning_tpu.defenses.fltrust import fltrust  # noqa: F401
+from attacking_federate_learning_tpu.defenses.median import median  # noqa: F401

@@ -1,0 +1,18 @@
+"""Coordinate-wise median defense (Yin et al., ICML'18 — the companion
+estimator to the trimmed mean the reference implements at
+defences.py:44-52; the reference itself ships only the trimmed variant).
+
+One jnp.median along the client axis: robust to up to half the clients per
+coordinate, no selection state, fully shardable over the model axis.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from attacking_federate_learning_tpu.defenses.kernels import DEFENSES
+
+
+@DEFENSES.register("Median")
+def median(users_grads, users_count, corrupted_count):
+    return jnp.median(users_grads, axis=0)

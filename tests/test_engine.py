@@ -153,3 +153,45 @@ def test_bf16_grad_dtype_runs():
         state = exp.run_round(t)
     assert np.isfinite(np.asarray(state.weights)).all()
     assert state.weights.dtype == np.float32  # server state stays f32
+
+
+def test_fused_span_matches_per_round():
+    """run_span (one scanned device program) must produce exactly the
+    per-round loop's weights."""
+    cfg = small_cfg(epochs=7, mal_prop=0.2, defense="TrimmedMean")
+    a = FederatedExperiment(cfg, attacker=DriftAttack(1.5))
+    for t in range(7):
+        a.run_round(t)
+    b = FederatedExperiment(cfg, attacker=DriftAttack(1.5))
+    b.run_span(0, 7)
+    np.testing.assert_array_equal(np.asarray(a.state.weights),
+                                  np.asarray(b.state.weights))
+    assert int(b.state.round) == 7
+
+
+def test_run_uses_spans_with_same_eval_cadence():
+    """engine.run with spans evaluates at the same rounds as the reference
+    cadence (epoch % TEST_STEP == 0 or last, main.py:73)."""
+    cfg = small_cfg(epochs=12, test_step=5, mal_prop=0.0)
+    exp = FederatedExperiment(cfg, attacker=NoAttack())
+    out = exp.run()
+    assert out["epochs"] == [0, 5, 10, 11]
+
+
+def test_baseline_attacks_run():
+    from attacking_federate_learning_tpu.attacks import ATTACKS
+    for name in ["signflip", "noise"]:
+        cfg = small_cfg(epochs=2, mal_prop=0.3, defense="Median")
+        atk = ATTACKS[name](cfg)
+        exp = FederatedExperiment(cfg, attacker=atk)
+        for t in range(2):
+            state = exp.run_round(t)
+        assert np.isfinite(np.asarray(state.weights)).all()
+
+
+def test_median_defense_matches_numpy():
+    from attacking_federate_learning_tpu.defenses import DEFENSES
+    rng = np.random.default_rng(5)
+    G = rng.standard_normal((9, 17)).astype(np.float32)
+    out = np.asarray(DEFENSES["Median"](jnp.asarray(G), 9, 2))
+    np.testing.assert_allclose(out, np.median(G, axis=0), atol=1e-6)
