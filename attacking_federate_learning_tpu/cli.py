@@ -65,6 +65,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-checkpoint", action="store_true",
                    help="disable the acc>70%% checkpoint (reference "
                         "main.py:84-89 behavior is on by default)")
+    p.add_argument("--krum-scoring-method", default="sort",
+                   choices=["sort", "topk", "auto"],
+                   help="Krum/Bulyan score evaluation: oracle-verified "
+                        "'sort', or the faster complement-'topk' for "
+                        "large n / small f")
     p.add_argument("--krum-paper-scoring", action="store_true",
                    help="paper-faithful Krum scoring (n-f-2 closest) instead "
                         "of the reference's n-f (defences.py:26)")
@@ -109,6 +114,7 @@ def config_from_args(args) -> ExperimentConfig:
         backend=args.backend,
         mesh_shape=mesh_shape,
         krum_paper_scoring=args.krum_paper_scoring,
+        krum_scoring_method=args.krum_scoring_method,
         server_uses_faded_lr=args.server_uses_faded_lr,
         log_round_stats=args.round_stats,
         synth_train=args.synth_train,

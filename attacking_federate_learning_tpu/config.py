@@ -100,6 +100,9 @@ class ExperimentConfig:
     # Krum scores sum the n-f smallest distances (reference defences.py:26,
     # 33-34) rather than the paper's n-f-2.
     krum_paper_scoring: bool = False
+    # Score evaluation strategy: 'sort' (oracle-verified default), 'topk'
+    # (complement subtraction — faster at large n / small f), or 'auto'.
+    krum_scoring_method: str = "sort"
     # Attack statistics over the malicious cohort only (reference
     # malicious.py:14-19), matching the ALIE threat model.
 
@@ -114,6 +117,10 @@ class ExperimentConfig:
     log_round_stats: bool = False
 
     def __post_init__(self):
+        if self.krum_scoring_method not in ("sort", "topk", "auto"):
+            raise ValueError(
+                f"krum_scoring_method must be 'sort', 'topk' or 'auto', "
+                f"got {self.krum_scoring_method!r}")
         if self.fading_rate is None:
             self.fading_rate = FADING_RATES.get(self.dataset, 10000.0)
         if self.model is None:

@@ -61,9 +61,14 @@ class FederatedExperiment:
         self.f = cfg.corrupted_count
         check_defense_args(cfg.defense, self.n, self.f)
         self.defense_fn = DEFENSES[cfg.defense]
-        if cfg.krum_paper_scoring and cfg.defense in ("Krum", "Bulyan"):
-            self.defense_fn = functools.partial(self.defense_fn,
-                                                paper_scoring=True)
+        if cfg.defense in ("Krum", "Bulyan"):
+            kw = {}
+            if cfg.krum_paper_scoring:
+                kw["paper_scoring"] = True
+            if cfg.krum_scoring_method != "sort":
+                kw["method"] = cfg.krum_scoring_method
+            if kw:
+                self.defense_fn = functools.partial(self.defense_fn, **kw)
         if shardings is None and cfg.mesh_shape is not None:
             from attacking_federate_learning_tpu.parallel.mesh import make_plan
             shardings = make_plan(tuple(cfg.mesh_shape))

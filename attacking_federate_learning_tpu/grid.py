@@ -22,8 +22,9 @@ from attacking_federate_learning_tpu import config as C
 from attacking_federate_learning_tpu.config import ExperimentConfig
 
 
-DEFENSES_ALL = ["NoDefense", "Krum", "TrimmedMean", "Bulyan"]
-ATTACKS_ALL = ["none", "alie", "backdoor"]
+DEFENSES_ALL = ["NoDefense", "Krum", "TrimmedMean", "Bulyan", "Median",
+                "FLTrust"]
+ATTACKS_ALL = ["none", "alie", "backdoor", "signflip", "noise"]
 
 
 def run_grid(base: ExperimentConfig, defenses=None, attacks=None,
