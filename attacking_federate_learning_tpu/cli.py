@@ -123,11 +123,19 @@ def config_from_args(args) -> ExperimentConfig:
 
 
 def apply_backend(backend: str):
-    """Select the JAX platform before jax is imported (cfg.backend)."""
+    """Select the JAX platform (cfg.backend).
+
+    Env vars cover the normal case; on images whose sitecustomize imports
+    jax at interpreter start the platform config is already frozen, so the
+    live config is updated too (backend init is lazy, so this is still in
+    time as long as no jax op has run)."""
     if backend == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
-        # Disable this image's TPU-relay site hook for CPU-only runs.
+        # Keep subprocesses off this image's TPU-relay site hook.
         os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     elif backend == "tpu":
         os.environ.setdefault("JAX_PLATFORMS", "tpu,axon")
 

@@ -1,20 +1,35 @@
-"""Test harness: 8 virtual CPU devices so every shard_map / pjit path runs
-in CI without a TPU (SURVEY.md §4(e)).  Must run before jax initializes."""
+"""Test harness: CPU-only jax with 8 virtual devices so every shard_map /
+pjit path runs in CI without a TPU (SURVEY.md §4(e))."""
 
 import os
 
-# Force CPU and disable the axon TPU site hook: on this image a
-# sitecustomize.py dials the (single-client) TPU relay at interpreter start,
-# which serializes/hangs concurrent test runs.  Clearing PALLAS_AXON_POOL_IPS
-# makes the hook a no-op; tests are CPU-only by design.
-os.environ["PALLAS_AXON_POOL_IPS"] = ""
-os.environ["JAX_PLATFORMS"] = "cpu"
+# Environment setup must precede backend initialization (XLA_FLAGS and the
+# compile cache are read lazily at CPU-client creation).  Note that this
+# image's sitecustomize imports jax at interpreter start — BEFORE this file
+# runs — so env vars alone cannot change the already-frozen platform
+# selection for this process; they still matter for subprocesses and for
+# the lazily-read flags below.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""          # keep child processes off
+os.environ["JAX_PLATFORMS"] = "cpu"              # the TPU relay
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
 # Persistent compile cache: the suite compiles dozens of kernel variants and
 # this box has one core — caching cuts re-runs from minutes to seconds.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.join(os.path.dirname(__file__), os.pardir,
                                    ".jax_cache"))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+# Because jax is already imported (see above), the only effective platform
+# override for THIS process is the live config.  Backend init is lazy, so
+# doing it here — before any test touches a jax op — keeps the whole suite
+# on CPU even under the default environment (and even when the TPU relay
+# is unreachable, which otherwise blocks forever in a connect-retry loop).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
@@ -45,7 +60,3 @@ def hard_final_accuracy(ds, defense, attack, mal_prop, rounds=30):
         exp.run_round(t)
     _, correct = exp.evaluate(exp.state.weights)
     return 100.0 * float(correct) / len(ds.test_y)
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
