@@ -1,0 +1,5 @@
+"""``python -m attacking_federate_learning_tpu`` runs the experiment CLI."""
+
+from attacking_federate_learning_tpu.cli import main
+
+main()

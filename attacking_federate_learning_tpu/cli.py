@@ -2,8 +2,8 @@
 
 Flag-compatible with the reference driver (reference main.py:103-153),
 including short flags and defaults (-m 0.24, -z 1.5, -d NoDefense, -s MNIST,
--b No, -c 128, -e 300, -l 0.1), minus its typo'd ``-dispatch_weightsn`` alias
-for --users-count (main.py:118) and plus the TPU-era knobs: --backend,
+-b No, -c 128, -e 300, -l 0.1) and even its typo'd ``-dispatch_weightsn``
+alias for --users-count (main.py:118), plus the TPU-era knobs: --backend,
 --partition, --seed, --server-uses-faded-lr.  Unlike the reference CLI
 (main.py:114), CIFAR100/WRN-40-4 is selectable here.
 
@@ -42,7 +42,11 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["No", "pattern", "1", "2", "3"],
                    help="no backdoor, pattern trigger, or single-sample "
                         "backdoor with the given training index")
-    p.add_argument("-n", "--users-count", default=10, type=int)
+    # '-dispatch_weightsn' mirrors the reference CLI's typo'd alias for
+    # --users-count (reference main.py:118) so reference invocations work
+    # verbatim.
+    p.add_argument("-n", "-dispatch_weightsn", "--users-count", default=10,
+                   type=int)
     p.add_argument("-c", "--batch_size", default=128, type=int)
     p.add_argument("-e", "--epochs", default=300, type=int)
     p.add_argument("-l", "--learning_rate", default=0.1, type=float)
@@ -53,9 +57,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dirichlet-alpha", default=0.5, type=float)
     p.add_argument("--seed", default=0, type=int)
     p.add_argument("--data-dir", default="data", type=str)
-    p.add_argument("--synth-train", default=10000, type=int,
+    p.add_argument("--synth-train", default=ExperimentConfig.synth_train,
+                   type=int,
                    help="training examples for SYNTH_* / fallback datasets")
-    p.add_argument("--synth-test", default=2000, type=int,
+    p.add_argument("--synth-test", default=ExperimentConfig.synth_test,
+                   type=int,
                    help="test examples for SYNTH_* / fallback datasets")
     p.add_argument("--backend", default="auto",
                    choices=["auto", "cpu", "tpu"],

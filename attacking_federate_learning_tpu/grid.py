@@ -101,11 +101,24 @@ def main(argv=None):
     p.add_argument("--defenses", nargs="*", default=None)
     p.add_argument("--attacks", nargs="*", default=None)
     p.add_argument("--seed", default=0, type=int)
+    p.add_argument("--backend", default="auto",
+                   choices=["auto", "cpu", "tpu"])
+    p.add_argument("--synth-train", default=ExperimentConfig.synth_train,
+                   type=int)
+    p.add_argument("--synth-test", default=ExperimentConfig.synth_test,
+                   type=int)
     args = p.parse_args(argv)
+
+    from attacking_federate_learning_tpu.cli import apply_backend
+    apply_backend(args.backend)
+
     base = ExperimentConfig(dataset=args.dataset,
                             users_count=args.users_count,
                             mal_prop=args.mal_prop, epochs=args.epochs,
-                            batch_size=args.batch_size, seed=args.seed)
+                            batch_size=args.batch_size, seed=args.seed,
+                            backend=args.backend,
+                            synth_train=args.synth_train,
+                            synth_test=args.synth_test)
     run_grid(base, args.defenses, args.attacks)
 
 

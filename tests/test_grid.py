@@ -1,0 +1,37 @@
+"""Grid runner: incremental summary, guard skipping, cell structure."""
+
+import json
+
+from attacking_federate_learning_tpu import config as C
+from attacking_federate_learning_tpu.config import ExperimentConfig
+from attacking_federate_learning_tpu.grid import run_grid
+
+
+def test_grid_cells_and_guard_skip(tmp_path):
+    base = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=10,
+                            mal_prop=0.24, batch_size=16, epochs=3,
+                            synth_train=256, synth_test=64,
+                            log_dir=str(tmp_path))
+    out_path = tmp_path / "summary.jsonl"
+    results = run_grid(base, defenses=["NoDefense", "Bulyan"],
+                       attacks=["none", "alie"], out_path=str(out_path))
+    assert len(results) == 4
+    # Bulyan with n=10, f=2 violates n >= 4f+3 -> recorded skip, not crash.
+    skipped = [r for r in results if "skipped" in r]
+    assert {(r["defense"], r["attack"]) for r in skipped} == {
+        ("Bulyan", "alie")}
+    ran = [r for r in results if "final_accuracy" in r]
+    assert all(0.0 <= r["final_accuracy"] <= 100.0 for r in ran)
+    # Summary written incrementally, one JSON line per cell.
+    lines = [json.loads(x) for x in out_path.read_text().splitlines()]
+    assert len(lines) == 4
+
+
+def test_grid_none_attack_sets_zero_malicious(tmp_path):
+    base = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=8,
+                            mal_prop=0.25, batch_size=16, epochs=2,
+                            synth_train=128, synth_test=32,
+                            log_dir=str(tmp_path))
+    results = run_grid(base, defenses=["Krum"], attacks=["none"],
+                       out_path=str(tmp_path / "s.jsonl"))
+    assert results[0]["final_accuracy"] >= 0.0
