@@ -2,4 +2,5 @@
 
 from attacking_federate_learning_tpu.cli import main
 
-main()
+if __name__ == "__main__":
+    main()
