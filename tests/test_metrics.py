@@ -1,0 +1,67 @@
+"""RunLogger (tee, CSV schema, JSONL records) and PhaseTimer."""
+
+import json
+import os
+import time
+
+import numpy as np
+
+from attacking_federate_learning_tpu import config as C
+from attacking_federate_learning_tpu.config import ExperimentConfig
+from attacking_federate_learning_tpu.utils.metrics import RunLogger
+from attacking_federate_learning_tpu.utils.profiling import PhaseTimer
+
+
+def make_cfg(tmp_path, **kw):
+    kw.setdefault("dataset", C.SYNTH_MNIST)
+    kw.setdefault("log_dir", str(tmp_path))
+    return ExperimentConfig(**kw)
+
+
+def test_tee_to_output_file(tmp_path):
+    """Reference my_print semantics (main.py:13-18): with --output, lines
+    append to the file instead of stdout."""
+    out = tmp_path / "run.log"
+    cfg = make_cfg(tmp_path, output=str(out))
+    logger = RunLogger(cfg, cfg.output, cfg.log_dir)
+    logger.print("hello")
+    logger.print("no newline", end="")
+    assert out.read_text() == "hello\nno newline"
+
+
+def test_record_eval_and_csv_schema(tmp_path):
+    cfg = make_cfg(tmp_path, defense="Krum", num_std=1.5, mal_prop=0.24)
+    logger = RunLogger(cfg, None, cfg.log_dir)
+    acc = logger.record_eval(epoch=5, test_loss=0.01, correct=1800,
+                             test_size=2000)
+    assert np.isclose(acc, 90.0)
+    logger.record_eval(epoch=10, test_loss=0.005, correct=1900,
+                       test_size=2000)
+    logger.finish()
+
+    # CSV with the reference filename schema (main.py:100).
+    csv = os.path.join(cfg.log_dir, cfg.csv_name())
+    assert os.path.exists(csv)
+    vals = np.loadtxt(csv, delimiter=",")
+    np.testing.assert_allclose(vals, [90.0, 95.0])
+    assert "Krum" in os.path.basename(csv)
+    assert "stdev_1.5" in os.path.basename(csv)
+
+    # Structured JSONL carries both evals.
+    with open(logger.jsonl_path) as f:
+        kinds = [json.loads(x)["kind"] for x in f]
+    assert kinds.count("eval") == 2
+
+
+def test_phase_timer_accumulates_and_syncs():
+    timer = PhaseTimer()
+    with timer.phase("a"):
+        time.sleep(0.01)
+    with timer.phase("a"):
+        time.sleep(0.01)
+    with timer.phase("b", sync_on=lambda: None):
+        pass
+    s = timer.summary()
+    assert s["a"]["count"] == 2
+    assert s["a"]["total_s"] >= 0.02
+    assert s["b"]["count"] == 1
