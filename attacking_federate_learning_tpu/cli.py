@@ -73,9 +73,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "main.py:84-89 behavior is on by default)")
     p.add_argument("--krum-scoring-method", default="sort",
                    choices=["sort", "topk", "auto"],
-                   help="Krum/Bulyan score evaluation: oracle-verified "
-                        "'sort', or the faster complement-'topk' for "
-                        "large n / small f")
+                   help="Krum/Bulyan score evaluation: cancellation-free "
+                        "'sort' (default), complement-'topk' (cheaper at "
+                        "large n / small f; a subtraction — check tolerance "
+                        "for your threat model), or 'auto' to pick by shape")
+    p.add_argument("--distance-impl", default="auto",
+                   choices=["auto", "xla", "pallas", "host", "ring",
+                            "allgather"],
+                   help="Krum/Bulyan distance engine (defenses/kernels.py): "
+                        "XLA Gram matmul, fused pallas TPU kernel, host "
+                        "BLAS (CPU backend), or the blockwise shard_map "
+                        "schedules over the clients mesh axis "
+                        "(ring/allgather need --mesh-shape)")
     p.add_argument("--krum-paper-scoring", action="store_true",
                    help="paper-faithful Krum scoring (n-f-2 closest) instead "
                         "of the reference's n-f (defences.py:26)")
@@ -121,6 +130,7 @@ def config_from_args(args) -> ExperimentConfig:
         mesh_shape=mesh_shape,
         krum_paper_scoring=args.krum_paper_scoring,
         krum_scoring_method=args.krum_scoring_method,
+        distance_impl=args.distance_impl,
         server_uses_faded_lr=args.server_uses_faded_lr,
         log_round_stats=args.round_stats,
         synth_train=args.synth_train,

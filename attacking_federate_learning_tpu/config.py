@@ -100,9 +100,26 @@ class ExperimentConfig:
     # Krum scores sum the n-f smallest distances (reference defences.py:26,
     # 33-34) rather than the paper's n-f-2.
     krum_paper_scoring: bool = False
-    # Score evaluation strategy: 'sort' (oracle-verified default), 'topk'
-    # (complement subtraction — faster at large n / small f), or 'auto'.
+    # Score evaluation strategy: 'sort' (default — oracle-verified and
+    # cancellation-free under arbitrary attacker magnitudes), 'topk'
+    # (complement subtraction — cheaper at large n / small f, but a
+    # subtraction, so opt in after checking tolerance for your threat
+    # model), or 'auto' (pick by shape).  The round-1 CPU bench regression
+    # attributed to 'sort' was actually the XLA:CPU gemm — see
+    # distance_impl below — so the numerically safest method stays default.
     krum_scoring_method: str = "sort"
+    # Distance engine for Krum/Bulyan (defenses/kernels.py):
+    #   'auto'      xla inside the engine's traced round programs (a host
+    #               round-trip there would cost more than it saves —
+    #               core/engine.py:_wire_distance_defense); host BLAS for
+    #               eager CPU-backend kernel calls (the bench fallback)
+    #   'xla'       Gram matmul + epilogue (ops/distances.py)
+    #   'pallas'    fused-epilogue TPU kernel (ops/pallas_distances.py)
+    #   'host'      NumPy/BLAS (defenses/host.py; pure_callback in-jit)
+    #   'ring'      blockwise ppermute schedule over the clients mesh axis
+    #   'allgather' one all_gather + per-device tiles
+    # (ring/allgather require a device mesh, parallel/distances.py).
+    distance_impl: str = "auto"
     # Attack statistics over the malicious cohort only (reference
     # malicious.py:14-19), matching the ALIE threat model.
 
@@ -121,6 +138,11 @@ class ExperimentConfig:
             raise ValueError(
                 f"krum_scoring_method must be 'sort', 'topk' or 'auto', "
                 f"got {self.krum_scoring_method!r}")
+        if self.distance_impl not in ("auto", "xla", "pallas", "host",
+                                      "ring", "allgather"):
+            raise ValueError(
+                f"distance_impl must be one of auto/xla/pallas/host/ring/"
+                f"allgather, got {self.distance_impl!r}")
         if self.fading_rate is None:
             self.fading_rate = FADING_RATES.get(self.dataset, 10000.0)
         if self.model is None:

