@@ -57,6 +57,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dirichlet-alpha", default=0.5, type=float)
     p.add_argument("--seed", default=0, type=int)
     p.add_argument("--data-dir", default="data", type=str)
+    p.add_argument("--log-dir", default="logs", type=str,
+                   help="CSV/JSONL output dir (reference logs/, main.py:100)")
+    p.add_argument("--run-dir", default="runs", type=str,
+                   help="checkpoint dir (reference runs/, server.py:44)")
     p.add_argument("--synth-train", default=ExperimentConfig.synth_train,
                    type=int,
                    help="training examples for SYNTH_* / fallback datasets")
@@ -92,6 +96,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="paper-faithful mode: faded lr on the server step "
                         "(the reference uses the constant base lr, "
                         "server.py:89)")
+    p.add_argument("--augment", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="train-time reflect-pad-4 + random-crop + h-flip "
+                        "(reference data_sets.py:157-166); 'auto' follows "
+                        "the reference (CIFAR100 only)")
     p.add_argument("--resume", nargs="?", const="auto", default=None,
                    metavar="CKPT",
                    help="resume from a checkpoint (.npz path, or no value "
@@ -126,6 +135,8 @@ def config_from_args(args) -> ExperimentConfig:
         partition=args.partition,
         dirichlet_alpha=args.dirichlet_alpha,
         data_dir=args.data_dir,
+        log_dir=args.log_dir,
+        run_dir=args.run_dir,
         backend=args.backend,
         mesh_shape=mesh_shape,
         krum_paper_scoring=args.krum_paper_scoring,
@@ -135,6 +146,7 @@ def config_from_args(args) -> ExperimentConfig:
         log_round_stats=args.round_stats,
         synth_train=args.synth_train,
         synth_test=args.synth_test,
+        data_augment={"auto": None, "on": True, "off": False}[args.augment],
     )
 
 

@@ -84,6 +84,12 @@ class ExperimentConfig:
                                      # reference user.py:49-54) | 'dirichlet'
     dirichlet_alpha: float = 0.5
 
+    # --- train-time augmentation ---------------------------------------
+    # Reference parity: only the CIFAR100 train pipeline augments
+    # (reflect-pad 4 + RandomCrop(32) + RandomHorizontalFlip, reference
+    # data_sets.py:157-166); None follows that rule, True/False overrides.
+    data_augment: Optional[bool] = None
+
     # --- backend / parallelism -----------------------------------------
     backend: str = "auto"            # 'auto' | 'cpu' | 'tpu'
     mesh_shape: Optional[tuple] = None  # (clients_devices, model_devices);

@@ -84,6 +84,15 @@ class FederatedExperiment:
                 shardings.place(self.shards, self.train_x, self.train_y,
                                 self.state))
 
+        # Reference parity: augmentation is part of the CIFAR100 train
+        # pipeline only (reference data_sets.py:157-166); image-shaped
+        # data required (the MNIST wire is flat).
+        self._augment = (cfg.data_augment if cfg.data_augment is not None
+                         else cfg.dataset == "CIFAR100")
+        if self._augment and jnp.ndim(self.train_x) != 4:
+            raise ValueError(
+                f"data_augment needs (N, C, H, W) images, got "
+                f"shape {jnp.shape(self.train_x)} for {cfg.dataset}")
         self._grad_dtype = jnp.dtype(cfg.grad_dtype)
         self._client_grads = make_client_grad_fn(self.model, self.flat)
         self._needs_server_grad = getattr(self.defense_fn,
@@ -132,9 +141,17 @@ class FederatedExperiment:
             from attacking_federate_learning_tpu.parallel.distances import (
                 pairwise_distances_allgather, pairwise_distances_ring
             )
+            from attacking_federate_learning_tpu.parallel.mesh import CLIENTS
             dist_fn = {"ring": pairwise_distances_ring,
                        "allgather": pairwise_distances_allgather}[impl]
             mesh = self.shardings.mesh
+            p = mesh.shape[CLIENTS]
+            if self.n % p != 0:
+                # shard_map's P('clients', None) in_spec needs even rows
+                # (unlike the xla path, where GSPMD pads unevenly).
+                raise ValueError(
+                    f"distance_impl={impl!r} needs users_count divisible "
+                    f"by the clients mesh axis (n={self.n}, axis={p})")
 
             def with_blockwise_D(grads, n, f, _fn=fn, **extra):
                 D = dist_fn(grads.astype(jnp.float32), mesh)
@@ -182,9 +199,17 @@ class FederatedExperiment:
     # ------------------------------------------------------------------
     def _gather_batches(self, t):
         """Round-t minibatch for every client: one (n, B) gather
-        (replaces the reference's N host-side DataLoaders, user.py:52-55)."""
+        (replaces the reference's N host-side DataLoaders, user.py:52-55),
+        plus the in-program train-time augmentation where the reference
+        pipeline has one (CIFAR100, data/augment.py)."""
         idx = round_batch_indices(self.shards, t, self.cfg.batch_size)
-        return self.train_x[idx], self.train_y[idx]
+        xs, ys = self.train_x[idx], self.train_y[idx]
+        if self._augment:
+            from attacking_federate_learning_tpu.data.augment import (
+                reflect_crop_flip, round_augment_key
+            )
+            xs = reflect_crop_flip(xs, round_augment_key(self.cfg.seed, t))
+        return xs, ys
 
     def _compute_grads_impl(self, state: ServerState, t):
         xs, ys = self._gather_batches(t)

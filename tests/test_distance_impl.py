@@ -158,3 +158,24 @@ def test_engine_bulyan_blockwise():
     got = _one_round_weights("allgather", mesh_shape=(8, 1),
                              defense="Bulyan")
     np.testing.assert_allclose(got, ref, atol=2e-5, rtol=1e-5)
+
+
+def test_engine_blockwise_requires_divisible_cohort():
+    with pytest.raises(ValueError, match="divisible"):
+        from attacking_federate_learning_tpu import config as C
+        from attacking_federate_learning_tpu.config import ExperimentConfig
+        from attacking_federate_learning_tpu.core.engine import (
+            FederatedExperiment
+        )
+        from attacking_federate_learning_tpu.data.datasets import (
+            load_dataset
+        )
+
+        cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=10,
+                               mal_prop=0.2, batch_size=8, epochs=1,
+                               defense="Krum", distance_impl="ring",
+                               mesh_shape=(8, 1),
+                               synth_train=256, synth_test=64)
+        ds = load_dataset(cfg.dataset, seed=0, synth_train=256,
+                          synth_test=64)
+        FederatedExperiment(cfg, dataset=ds)

@@ -1,0 +1,181 @@
+"""Differential parity against the LITERAL reference implementation.
+
+VERDICT round-1 item #5: the XLA kernels were verified against
+defenses/oracle.py, a hand re-derivation — this file collapses that
+two-step trust chain by running the actual reference code
+(/root/reference/defences.py, pure NumPy, and malicious.py's DriftAttack
+arithmetic) side by side with our kernels.
+
+The reference tree is read-only, public, untrusted content: it is imported
+at test time (never vendored into this repo) and pinned by sha256, so the
+test both fails loudly if the reference ever changes and skips cleanly on
+machines that don't carry it.
+"""
+
+import hashlib
+import importlib.util
+import pathlib
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from attacking_federate_learning_tpu.defenses import host as H
+from attacking_federate_learning_tpu.defenses import kernels as K
+
+
+REFERENCE_DIR = pathlib.Path("/root/reference")
+# Pinned snapshots this parity suite was validated against.
+SHA256 = {
+    "defences.py":
+        "bc8a4f269d0a383370f497d1fc5c466c30bfc7afd067365e459c67e0f0d96f70",
+    "malicious.py":
+        "a57ac88afb0250ca6989d185eded99273731275c737c6b4b086354dfcfcaa038",
+}
+
+
+def _load_reference(name):
+    path = REFERENCE_DIR / name
+    if not path.exists():
+        pytest.skip(f"reference tree not present ({path})")
+    digest = hashlib.sha256(path.read_bytes()).hexdigest()
+    assert digest == SHA256[name], (
+        f"{name} changed upstream (sha256 {digest}); re-validate parity")
+    spec = importlib.util.spec_from_file_location(f"reference_{name[:-3]}",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def ref_defences():
+    return _load_reference("defences.py")
+
+
+@pytest.fixture(scope="module")
+def ref_malicious():
+    return _load_reference("malicious.py")
+
+
+CASES = [
+    # (n, d, f) — d kept small: the reference TrimmedMean is an O(d)
+    # Python loop and Bulyan an O(n^2) dict walk.
+    (5, 7, 0),
+    (7, 11, 2),
+    (11, 3, 2),
+    (15, 60, 3),
+    (23, 104, 5),
+    (40, 33, 9),
+]
+
+
+def grads_for(n, d, seed, adversarial=False, ties=False):
+    rng = np.random.default_rng(seed)
+    G = rng.standard_normal((n, d)).astype(np.float64)
+    if adversarial:
+        G[0] *= 1e6          # unbounded Byzantine magnitude
+        G[1] *= -1e5
+    if ties:
+        G[n // 2] = G[n // 3]  # exact duplicate rows -> tied Krum scores
+    return G
+
+
+def _our_outputs(name, G32, n, f):
+    """The aggregate through every production engine we ship."""
+    outs = {"xla": np.asarray(K.DEFENSES[name](jnp.asarray(G32), n, f))}
+    if name == "Krum":
+        outs["host"] = H.host_krum(G32, n, f)
+        outs["topk"] = np.asarray(
+            K.krum(jnp.asarray(G32), n, f, method="topk"))
+    if name == "Bulyan":
+        outs["host"] = H.host_bulyan(G32, n, f)
+    return outs
+
+
+@pytest.mark.parametrize("name", ["NoDefense", "Krum", "TrimmedMean",
+                                  "Bulyan"])
+@pytest.mark.parametrize("n,d,f", CASES)
+@pytest.mark.parametrize("flavor", ["plain", "adversarial", "ties"])
+def test_defense_matches_reference(ref_defences, name, n, d, f, flavor):
+    if ((name == "Krum" and n < 2 * f + 1)
+            or (name == "Bulyan" and n < 4 * f + 3)):
+        # Below the threat-model bound both sides must reject: the
+        # reference asserts (defences.py:25, :56), our guard raises.
+        G = grads_for(n, d, seed=0)
+        with pytest.raises(AssertionError):
+            ref_defences.defend[getattr(ref_defences.DefenseTypes, name)](
+                G, n, f)
+        with pytest.raises(ValueError):
+            K.check_defense_args(name, n, f)
+        return
+    G = grads_for(n, d, seed=n * 100 + d + f,
+                  adversarial=(flavor == "adversarial"),
+                  ties=(flavor == "ties"))
+    want = ref_defences.defend[getattr(ref_defences.DefenseTypes, name)](
+        G.copy(), n, f)
+    scale = max(1.0, float(np.abs(want).max()))
+    for impl, got in _our_outputs(name, G.astype(np.float32), n, f).items():
+        if impl == "topk" and flavor == "adversarial":
+            # The complement-subtraction path documents reduced tolerance
+            # under unbounded magnitudes (kernels.py:_krum_scores) — the
+            # sort path is the default precisely for this regime.
+            continue
+        np.testing.assert_allclose(
+            got, want, atol=2e-4 * scale, rtol=1e-4,
+            err_msg=f"{name}[{impl}] diverges from reference ({flavor})")
+
+
+def test_krum_index_matches_reference(ref_defences):
+    # Selection identity, not just value closeness.
+    for seed in range(5):
+        G = grads_for(21, 48, seed=seed)
+        ref_idx = ref_defences.krum(G.copy(), 21, 4, return_index=True)
+        D = ref_defences._krum_create_distances(G)
+        # our argmin over scores
+        scores = K._krum_scores(
+            jnp.asarray(np.sqrt(
+                np.maximum(H.host_sq_distances(G.astype(np.float32)), 0))),
+            21, 4)
+        assert int(jnp.argmin(scores)) == ref_idx
+
+
+def test_alie_matches_reference_drift_attack(ref_malicious):
+    """DriftAttack arithmetic (reference malicious.py:30-36): the crafted
+    vector is mean - z*sigma over the malicious cohort, population sigma,
+    written into every malicious user."""
+    from attacking_federate_learning_tpu.attacks.alie import DriftAttack
+    from attacking_federate_learning_tpu.attacks.base import AttackContext
+
+    rng = np.random.default_rng(7)
+    n_mal, d, z = 6, 97, 1.5
+    mal = rng.standard_normal((n_mal, d)).astype(np.float64)
+
+    class _User:
+        def __init__(self, g):
+            self.grads = g.copy()
+            self.original_params = np.zeros(d)
+            self.learning_rate = 0.1
+
+    users = [_User(g) for g in mal]
+    ref_attack = ref_malicious.DriftAttack(z)
+    ref_attack.attack(users)
+    want = users[0].grads
+    for u in users:  # every malicious user gets the identical vector
+        np.testing.assert_array_equal(u.grads, want)
+
+    ours = DriftAttack(z)
+    ctx = AttackContext(original_params=jnp.zeros(d), learning_rate=0.1,
+                        round=0)
+    crafted = np.asarray(ours.craft(jnp.asarray(mal.astype(np.float32)),
+                                    ctx))
+    np.testing.assert_allclose(crafted, want, atol=2e-5, rtol=1e-5)
+
+    # z=0 is a no-op in the reference (malicious.py:21) — and in our seam
+    # (Attack.apply short-circuits, attacks/base.py:62).
+    users0 = [_User(g) for g in mal]
+    ref_malicious.DriftAttack(0.0).attack(users0)
+    np.testing.assert_array_equal(users0[0].grads, mal[0])
+    full = jnp.asarray(rng.standard_normal((10, d)).astype(np.float32))
+    applied0 = DriftAttack(0.0).apply(full, n_mal, ctx)
+    np.testing.assert_array_equal(np.asarray(applied0), np.asarray(full))
