@@ -47,12 +47,19 @@ from attacking_federate_learning_tpu.utils.flatten import make_flattener
 
 
 class BackdoorAttack(Attack):
-    fusable = False
     name = "backdoor"
+    # The engine checks aggregated weights for finiteness after fused
+    # rounds/spans — the in-program replacement for the reference's
+    # host-side nan raise (backdoor.py:145-152), see craft() below.
+    checks_finite = True
 
     def __init__(self, cfg, dataset, model=None, flat=None, rng=None):
         super().__init__(cfg.num_std)
         self.cfg = cfg
+        # The whole pipeline (shadow train included) is pure jitted jax,
+        # so the round can fuse it (cfg.backdoor_fused, default).  Staged
+        # mode retains the reference's exact per-round host nan guard.
+        self.fusable = bool(getattr(cfg, "backdoor_fused", True))
         self.backdoor = cfg.backdoor
         self.alpha = cfg.alpha
         self.model = model or get_model(cfg.model)
@@ -167,9 +174,13 @@ class BackdoorAttack(Attack):
     # ------------------------------------------------------------------
     def craft(self, mal_grads, ctx):
         out = self._craft(mal_grads, ctx.original_params, ctx.learning_rate)
-        if not bool(jnp.isfinite(out).all()):
-            raise FloatingPointError(
-                "Got nan in backdoor shadow training")  # backdoor.py:145-152
+        if not isinstance(out, jax.core.Tracer):
+            # Staged/eager path: the reference's per-round host nan guard
+            # (backdoor.py:145-152).  Inside a fused round program the
+            # engine checks the aggregated weights instead (checks_finite).
+            if not bool(jnp.isfinite(out).all()):
+                raise FloatingPointError(
+                    "Got nan in backdoor shadow training")
         return out
 
     def test_asr(self, flat_w, logger=None, tag="POST"):

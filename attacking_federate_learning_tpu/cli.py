@@ -96,6 +96,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="paper-faithful mode: faded lr on the server step "
                         "(the reference uses the constant base lr, "
                         "server.py:89)")
+    p.add_argument("--backdoor-staged", action="store_true",
+                   help="run the backdoor via the staged per-round path "
+                        "(the reference's host nan guard every round, "
+                        "backdoor.py:145-152) instead of fusing the "
+                        "shadow train into the round program")
     p.add_argument("--augment", default="auto",
                    choices=["auto", "on", "off"],
                    help="train-time reflect-pad-4 + random-crop + h-flip "
@@ -147,6 +152,7 @@ def config_from_args(args) -> ExperimentConfig:
         synth_train=args.synth_train,
         synth_test=args.synth_test,
         data_augment={"auto": None, "on": True, "off": False}[args.augment],
+        backdoor_fused=not args.backdoor_staged,
     )
 
 
@@ -201,15 +207,26 @@ def main(argv=None):
         path = args.resume if args.resume != "auto" else ckpt.path
         if not os.path.exists(path):
             raise SystemExit(f"--resume: no checkpoint at {path}")
-        exp.state = ckpt.resume(path)
+        if path.endswith((".pth.tar", ".pth", ".pt")):
+            # Reference-produced torch checkpoint (reference server.py:40-48).
+            from attacking_federate_learning_tpu.utils.checkpoint import (
+                import_reference_checkpoint
+            )
+            exp.state, ref_acc = import_reference_checkpoint(
+                path, expected_dim=exp.flat.dim)
+            if checkpointer is not None:
+                checkpointer.best_acc = ref_acc
+            logger.print(f"Imported reference checkpoint (acc {ref_acc})")
+        else:
+            exp.state = ckpt.resume(path)
+            if checkpointer is not None:
+                # Don't let the first post-resume eval overwrite a better
+                # checkpoint (keep_best seeding).
+                checkpointer.best_acc = float(np.load(path)["accuracy"])
         if exp.shardings is not None:
             # Restore the planned state sharding the engine set at init.
             _, _, _, exp.state = exp.shardings.place(
                 exp.shards, exp.train_x, exp.train_y, exp.state)
-        if checkpointer is not None:
-            # Don't let the first post-resume eval overwrite a better
-            # checkpoint (keep_best seeding).
-            checkpointer.best_acc = float(np.load(path)["accuracy"])
         logger.print(f"Resumed from round {int(exp.state.round)}")
     timer = PhaseTimer() if args.profile else None
     with xla_trace(args.trace_dir):

@@ -55,6 +55,12 @@ class ExperimentConfig:
     mal_weight_decay: float = 1e-4   # reference backdoor.py:132
     # (the reference's shadow-SGD momentum is inert — fresh optimizer per
     # batch, backdoor.py:132 — so it is not a knob here)
+    # Fuse the (pure, jitted) shadow-train + clip pipeline into the round
+    # program so backdoor rounds run without a per-round host hop; False
+    # restores the staged path with the reference's per-round nan guard
+    # (backdoor.py:145-152) — fused mode checks the aggregated weights at
+    # span boundaries instead.
+    backdoor_fused: bool = True
 
     # --- defense --------------------------------------------------------
     defense: str = "NoDefense"       # reference main.py:112

@@ -264,17 +264,33 @@ class FederatedExperiment:
 
         self._round_diagnostics = round_diagnostics
 
+        # In-program replacement for the reference's host-side shadow-train
+        # nan guard (backdoor.py:145-152): track isnan over the crafted
+        # rows only (rows [0, f)), so a diverging *server* update can't be
+        # misattributed to the attack.  Skipped when no crafting happens
+        # (f == 0 or z == 0, mirroring the reference's early returns,
+        # malicious.py:11, :21).
+        self._check_attack_nan = (
+            getattr(self.attacker, "checks_finite", False)
+            and self.f > 0 and getattr(self.attacker, "num_std", 1) != 0)
+
         if getattr(self.attacker, "fusable", True):
             def fused_core(state, t):
                 grads = self._compute_grads_impl(state, t)
                 grads = self.attacker.apply(grads, self.f, ctx_for(state, t))
                 return self._aggregate_impl(state, grads, t), grads
 
+            def crafted_nan(grads):
+                return jnp.isnan(
+                    grads[: self.f].astype(jnp.float32)).any()
+
             def fused(state, t):
                 new_state, grads = fused_core(state, t)
                 diag = (round_diagnostics(grads, new_state, t)
                         if cfg.log_round_stats else {})
-                return new_state, diag
+                bad = (crafted_nan(grads) if self._check_attack_nan
+                       else jnp.asarray(False))
+                return new_state, diag, bad
 
             def fused_span(state, t0, count):
                 # One device program for `count` rounds: steady-state
@@ -282,11 +298,15 @@ class FederatedExperiment:
                 # (the reference makes 3N+2 host->object calls per round,
                 # main.py:66-71).  count is a traced operand (fori_loop),
                 # so every span length shares one compilation.
-                def body(i, s):
-                    s2, _ = fused_core(s, t0 + i)
-                    return s2
+                def body(i, carry):
+                    s, bad = carry
+                    s2, grads = fused_core(s, t0 + i)
+                    if self._check_attack_nan:
+                        bad = bad | crafted_nan(grads)
+                    return s2, bad
 
-                return jax.lax.fori_loop(0, count, body, state)
+                return jax.lax.fori_loop(0, count, body,
+                                         (state, jnp.asarray(False)))
 
             self._fused_round = jax.jit(fused, donate_argnums=0)
             self._fused_span = jax.jit(fused_span, donate_argnums=0)
@@ -297,6 +317,12 @@ class FederatedExperiment:
             self._staged = True
 
     # ------------------------------------------------------------------
+    def _raise_if_attack_nan(self, bad):
+        """Host side of the crafted-rows nan flag (exact reference
+        message, backdoor.py:146)."""
+        if self._check_attack_nan and bool(bad):
+            raise FloatingPointError("Got nan in backdoor shadow training")
+
     def run_span(self, start: int, count: int) -> ServerState:
         """Run ``count`` rounds [start, start+count) as one scanned device
         program when the attack is fusable; falls back to per-round calls
@@ -310,18 +336,20 @@ class FederatedExperiment:
                 self.run_round(t)
         else:
             self.last_round_stats = None
-            self.state = self._fused_span(
+            self.state, bad = self._fused_span(
                 self.state, jnp.asarray(start, jnp.int32),
                 jnp.asarray(count, jnp.int32))
+            self._raise_if_attack_nan(bad)
         return self.state
 
     def run_round(self, t: int) -> ServerState:
         t = jnp.asarray(t, jnp.int32)
         self.last_round_stats = None
         if not self._staged:
-            self.state, diag = self._fused_round(self.state, t)
+            self.state, diag, bad = self._fused_round(self.state, t)
             if diag:
                 self.last_round_stats = diag
+            self._raise_if_attack_nan(bad)
         else:
             grads = self._compute_grads(self.state, t)
             grads = self.attacker.apply(grads, self.f,

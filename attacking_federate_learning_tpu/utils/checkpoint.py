@@ -71,3 +71,48 @@ class Checkpointer:
 
     def exists(self) -> bool:
         return os.path.exists(self.path)
+
+
+# Torch buffer entries that appear in a state_dict but not in
+# ``.parameters()`` — the reference wire format is parameters-only
+# (reference user.py:17-28), so they are excluded on import.
+_TORCH_BUFFER_SUFFIXES = ("running_mean", "running_var",
+                          "num_batches_tracked")
+
+
+def import_reference_checkpoint(path: str, expected_dim: Optional[int] =
+                                None):
+    """One-way importer for a reference-produced checkpoint.
+
+    The reference saves ``torch.save({'epoch','state_dict','acc'})`` to
+    ``runs/<dataset>/checkpoint.pth.tar`` (reference server.py:40-48).
+    This reads that file (or a bare state_dict) and flattens the
+    parameters in registration order — identical to the reference's
+    ``flatten_params`` over ``.parameters()`` (user.py:17-18) — so runs
+    can be cross-validated against reference-produced weights.
+
+    Returns ``(ServerState, accuracy)``.  The velocity is zero: the
+    reference never checkpoints it (server.py:36 excluded; SURVEY.md §5),
+    so a resume from a reference checkpoint is inexact by construction —
+    exactly as inexact as resuming the reference itself would be.
+    """
+    import torch
+
+    blob = torch.load(path, map_location="cpu", weights_only=False)
+    if isinstance(blob, dict) and "state_dict" in blob:
+        state_dict, epoch = blob["state_dict"], int(blob.get("epoch", 0))
+        acc = float(blob.get("acc", 0.0))
+    else:
+        state_dict, epoch, acc = blob, 0, 0.0
+    chunks = [np.asarray(v.detach().cpu().numpy(), np.float32).ravel()
+              for k, v in state_dict.items()
+              if not k.endswith(_TORCH_BUFFER_SUFFIXES)]
+    flat = np.concatenate(chunks)
+    if expected_dim is not None and flat.size != expected_dim:
+        raise ValueError(
+            f"reference checkpoint has {flat.size} parameters, "
+            f"model expects {expected_dim}")
+    state = ServerState(weights=jnp.asarray(flat),
+                        velocity=jnp.zeros(flat.size, jnp.float32),
+                        round=jnp.asarray(epoch, jnp.int32))
+    return state, acc

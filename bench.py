@@ -213,6 +213,32 @@ def main():
     except Exception as e:
         log(f"round-throughput probe skipped: {type(e).__name__}: {e}")
 
+    # --- backdoor rounds/sec: fused vs staged (stderr diagnostic) -------
+    try:
+        from attacking_federate_learning_tpu.attacks import make_attacker
+
+        def backdoor_rps(fused, n_clients=32, reps=10):
+            cfg = ExperimentConfig(
+                dataset="SYNTH_MNIST", users_count=n_clients, mal_prop=0.25,
+                batch_size=32, epochs=1, defense="TrimmedMean",
+                backdoor="pattern", backdoor_fused=fused)
+            ds = load_dataset(cfg.dataset, seed=0, synth_train=4096,
+                              synth_test=256)
+            exp = FederatedExperiment(
+                cfg, attacker=make_attacker(cfg, dataset=ds), dataset=ds)
+            exp.run_span(0, reps)
+            jax.block_until_ready(exp.state.weights)
+            t0 = time.perf_counter()
+            exp.run_span(reps, reps)
+            jax.block_until_ready(exp.state.weights)
+            return reps / (time.perf_counter() - t0)
+
+        log(f"backdoor_rounds_per_sec fused={backdoor_rps(True):.2f} "
+            f"staged={backdoor_rps(False):.2f} "
+            f"(32 clients, pattern trigger, TrimmedMean)")
+    except Exception as e:
+        log(f"backdoor probe skipped: {type(e).__name__}: {e}")
+
     # --- north-star probe: 10k clients, TPU only (stderr) ---------------
     try:
         if not on_accel:

@@ -195,3 +195,62 @@ def test_median_defense_matches_numpy():
     G = rng.standard_normal((9, 17)).astype(np.float32)
     out = np.asarray(DEFENSES["Median"](jnp.asarray(G), 9, 2))
     np.testing.assert_allclose(out, np.median(G, axis=0), atol=1e-6)
+
+
+def test_backdoor_fused_equals_staged():
+    """cfg.backdoor_fused folds the (pure, jitted) shadow-train pipeline
+    into the round program; it must be bit-identical to the staged path
+    (which keeps the reference's per-round host nan guard,
+    backdoor.py:145-152)."""
+    import numpy as np
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.attacks import make_attacker
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+
+    def weights(fused):
+        cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=8,
+                               mal_prop=0.25, batch_size=16, epochs=3,
+                               defense="TrimmedMean", backdoor="pattern",
+                               backdoor_fused=fused,
+                               synth_train=512, synth_test=64)
+        ds = load_dataset(cfg.dataset, seed=0, synth_train=512,
+                          synth_test=64)
+        exp = FederatedExperiment(cfg, attacker=make_attacker(cfg, dataset=ds),
+                                  dataset=ds)
+        exp.run_span(0, 3)
+        return np.asarray(exp.state.weights)
+
+    np.testing.assert_array_equal(weights(True), weights(False))
+
+
+def test_fused_backdoor_nan_guard_fires():
+    """A shadow-train nan must raise the reference's exact error
+    (backdoor.py:146) from the fused path too — via the in-program
+    crafted-rows isnan flag, not a blanket weights check."""
+    import numpy as np
+    import pytest
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.attacks import make_attacker
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+
+    cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=8,
+                           mal_prop=0.25, batch_size=16, epochs=2,
+                           defense="NoDefense", backdoor="pattern",
+                           # absurd shadow lr -> shadow train overflows
+                           mal_learning_rate=1e30,
+                           synth_train=512, synth_test=64)
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=512, synth_test=64)
+    exp = FederatedExperiment(cfg, attacker=make_attacker(cfg, dataset=ds),
+                              dataset=ds)
+    with pytest.raises(FloatingPointError, match="backdoor shadow"):
+        exp.run_span(0, 2)
+        # belt & braces: some overflows surface one span later
+        exp.run_span(2, 2)

@@ -116,3 +116,64 @@ def test_torch_flat_vector_roundtrips_through_wire():
     np.testing.assert_array_equal(
         np.asarray(params["fc2"]["bias"]),
         tnet.fc2.bias.detach().numpy())
+
+
+def test_import_reference_checkpoint(tmp_path):
+    """A reference-produced checkpoint.pth.tar (torch.save of
+    {'epoch','state_dict','acc'}, reference server.py:40-48) imports into
+    our ServerState with forward parity."""
+    from attacking_federate_learning_tpu.utils.checkpoint import (
+        import_reference_checkpoint
+    )
+
+    tnet = build_torch_mnist()
+    path = tmp_path / "checkpoint.pth.tar"
+    torch.save({"epoch": 42, "state_dict": tnet.state_dict(), "acc": 87.5},
+               str(path))
+
+    model = get_model("mnist_mlp")
+    flat = make_flattener(model.init(jax.random.key(0)))
+    state, acc = import_reference_checkpoint(str(path),
+                                             expected_dim=flat.dim)
+    assert acc == 87.5
+    assert int(state.round) == 42
+    assert np.all(np.asarray(state.velocity) == 0)  # reference never saves it
+
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((4, 784)).astype(np.float32)
+    ours = np.asarray(model.apply(flat.unravel(state.weights),
+                                  jnp.asarray(x)))
+    with torch.no_grad():
+        theirs = tnet(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-5, rtol=1e-4)
+
+
+def test_import_reference_checkpoint_dim_mismatch(tmp_path):
+    from attacking_federate_learning_tpu.utils.checkpoint import (
+        import_reference_checkpoint
+    )
+
+    tnet = build_torch_mnist()
+    path = tmp_path / "checkpoint.pth.tar"
+    torch.save({"epoch": 1, "state_dict": tnet.state_dict(), "acc": 0.0},
+               str(path))
+    with pytest.raises(ValueError, match="parameters"):
+        import_reference_checkpoint(str(path), expected_dim=123)
+
+
+def test_cli_resume_from_reference_checkpoint(tmp_path):
+    """--resume <checkpoint.pth.tar> routes through the importer and
+    continues training from the imported round."""
+    from attacking_federate_learning_tpu import cli
+
+    tnet = build_torch_mnist()
+    path = tmp_path / "checkpoint.pth.tar"
+    torch.save({"epoch": 2, "state_dict": tnet.state_dict(), "acc": 10.0},
+               str(path))
+    result = cli.main(["-s", "SYNTH_MNIST", "-e", "4", "-c", "16", "-n", "6",
+                       "-m", "0.0", "--synth-train", "256",
+                       "--synth-test", "64",
+                       "--log-dir", str(tmp_path / "logs"),
+                       "--run-dir", str(tmp_path / "runs"),
+                       "--resume", str(path)])
+    assert result["epochs"][-1] == 3  # continued from round 2
