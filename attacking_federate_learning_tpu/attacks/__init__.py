@@ -31,6 +31,15 @@ ATTACKS.register("noise",
                  lambda cfg, dataset=None: GaussianNoiseAttack(
                      cfg.num_std, seed=cfg.seed))
 
+from attacking_federate_learning_tpu.attacks.minmax import (  # noqa: E402
+    MinMaxAttack, MinSumAttack
+)
+
+ATTACKS.register("minmax",
+                 lambda cfg, dataset=None: MinMaxAttack(cfg.num_std))
+ATTACKS.register("minsum",
+                 lambda cfg, dataset=None: MinSumAttack(cfg.num_std))
+
 
 def make_attacker(cfg, dataset=None, name=None):
     """Attack selection mirroring reference main.py:44-54: a backdoor option

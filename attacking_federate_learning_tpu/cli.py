@@ -31,7 +31,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="how many standard deviations the attacker shifts")
     p.add_argument("-d", "--defense", default="NoDefense",
                    choices=["NoDefense", "Bulyan", "TrimmedMean", "Krum",
-                            "FLTrust", "Median"])
+                            "FLTrust", "Median", "GeoMedian", "NormBound"])
+    p.add_argument("--attack", default="auto",
+                   choices=["auto", "none", "alie", "backdoor", "signflip",
+                            "noise", "minmax", "minsum"],
+                   help="'auto' = reference behavior (backdoor if -b set, "
+                        "else ALIE, reference main.py:44-54); the rest are "
+                        "beyond-reference baselines (attacks/)")
     p.add_argument("-s", "--dataset", default=C.MNIST,
                    choices=[C.MNIST, C.CIFAR10, C.CIFAR100, C.SYNTH_MNIST,
                             C.SYNTH_CIFAR10, C.SYNTH_MNIST_HARD],
@@ -72,6 +78,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="JAX platform; must be chosen before jax initializes")
     p.add_argument("--mesh-shape", default=None, type=str,
                    help="'clients,model' device split, e.g. 8,1")
+    p.add_argument("--data-placement", default="device",
+                   choices=["device", "host_stream"],
+                   help="'device' holds the training set in HBM; "
+                        "'host_stream' keeps it in host RAM and "
+                        "double-buffers per-round batches (beyond-HBM "
+                        "datasets)")
     p.add_argument("--no-checkpoint", action="store_true",
                    help="disable the acc>70%% checkpoint (reference "
                         "main.py:84-89 behavior is on by default)")
@@ -144,6 +156,7 @@ def config_from_args(args) -> ExperimentConfig:
         run_dir=args.run_dir,
         backend=args.backend,
         mesh_shape=mesh_shape,
+        data_placement=args.data_placement,
         krum_paper_scoring=args.krum_paper_scoring,
         krum_scoring_method=args.krum_scoring_method,
         distance_impl=args.distance_impl,
@@ -175,7 +188,13 @@ def apply_backend(backend: str):
 
 
 def main(argv=None):
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.attack == "backdoor" and args.backdoor == "No":
+        # BackdoorAttack's poison set is derived from the -b trigger; an
+        # explicit --attack backdoor without one would build an empty set.
+        parser.error("--attack backdoor requires a trigger: "
+                     "-b pattern|1|2|3")
     apply_backend(args.backend)
     cfg = config_from_args(args)
 
@@ -197,7 +216,9 @@ def main(argv=None):
     dataset = load_dataset(cfg.dataset, cfg.data_dir, cfg.seed,
                            synth_train=cfg.synth_train,
                            synth_test=cfg.synth_test)
-    attacker = make_attacker(cfg, dataset=dataset)
+    attacker = make_attacker(cfg, dataset=dataset,
+                             name=None if args.attack == "auto"
+                             else args.attack)
     exp = FederatedExperiment(cfg, attacker=attacker, dataset=dataset)
     checkpointer = None if args.no_checkpoint else Checkpointer(cfg)
     if args.resume is not None:

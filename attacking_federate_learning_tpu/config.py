@@ -98,6 +98,12 @@ class ExperimentConfig:
 
     # --- backend / parallelism -----------------------------------------
     backend: str = "auto"            # 'auto' | 'cpu' | 'tpu'
+    # 'device' keeps the whole training set in HBM (MNIST/CIFAR fit);
+    # 'host_stream' keeps it in host RAM and double-buffers each round's
+    # (n, B) batch onto the device (data/stream.py — the beyond-HBM /
+    # FEMNIST-scale mode, SURVEY.md §7.3 #5).  Streaming feeds one round
+    # per device program, so eval-to-eval span fusion is off in that mode.
+    data_placement: str = "device"
     mesh_shape: Optional[tuple] = None  # (clients_devices, model_devices);
                                         # None -> all devices on client axis
     grad_dtype: str = "float32"      # dtype of the (n, d) gradient matrix;
@@ -155,6 +161,10 @@ class ExperimentConfig:
             raise ValueError(
                 f"distance_impl must be one of auto/xla/pallas/host/ring/"
                 f"allgather, got {self.distance_impl!r}")
+        if self.data_placement not in ("device", "host_stream"):
+            raise ValueError(
+                f"data_placement must be 'device' or 'host_stream', "
+                f"got {self.data_placement!r}")
         if self.fading_rate is None:
             self.fading_rate = FADING_RATES.get(self.dataset, 10000.0)
         if self.model is None:

@@ -25,6 +25,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from attacking_federate_learning_tpu.attacks.base import (
     Attack, AttackContext, NoAttack
@@ -76,23 +77,40 @@ class FederatedExperiment:
 
         shards = make_shards(cfg.partition, self.dataset.train_y, self.n,
                              cfg.seed, cfg.dirichlet_alpha)
-        self.shards = jnp.asarray(shards)
-        self.train_x = jnp.asarray(self.dataset.train_x)
-        self.train_y = jnp.asarray(self.dataset.train_y)
-        if shardings is not None:
-            self.shards, self.train_x, self.train_y, self.state = (
-                shardings.place(self.shards, self.train_x, self.train_y,
-                                self.state))
+        self._streaming = cfg.data_placement == "host_stream"
+        if self._streaming:
+            # Beyond-HBM mode (SURVEY.md §7.3 #5): the training set stays
+            # in host RAM; per-round batches are host-gathered and
+            # double-buffered onto the device (data/stream.py).
+            from attacking_federate_learning_tpu.data.stream import (
+                HostStream
+            )
+            self.shards = shards                      # host numpy
+            self.train_x = self.train_y = None
+            self.stream = HostStream(self.dataset.train_x,
+                                     self.dataset.train_y, shards,
+                                     cfg.batch_size, plan=shardings,
+                                     n_rounds=cfg.epochs)
+            if shardings is not None:
+                self.state = shardings.place_state(self.state)
+        else:
+            self.shards = jnp.asarray(shards)
+            self.train_x = jnp.asarray(self.dataset.train_x)
+            self.train_y = jnp.asarray(self.dataset.train_y)
+            if shardings is not None:
+                self.shards, self.train_x, self.train_y, self.state = (
+                    shardings.place(self.shards, self.train_x, self.train_y,
+                                    self.state))
 
         # Reference parity: augmentation is part of the CIFAR100 train
         # pipeline only (reference data_sets.py:157-166); image-shaped
         # data required (the MNIST wire is flat).
         self._augment = (cfg.data_augment if cfg.data_augment is not None
                          else cfg.dataset == "CIFAR100")
-        if self._augment and jnp.ndim(self.train_x) != 4:
+        if self._augment and np.ndim(self.dataset.train_x) != 4:
             raise ValueError(
                 f"data_augment needs (N, C, H, W) images, got "
-                f"shape {jnp.shape(self.train_x)} for {cfg.dataset}")
+                f"shape {np.shape(self.dataset.train_x)} for {cfg.dataset}")
         self._grad_dtype = jnp.dtype(cfg.grad_dtype)
         self._client_grads = make_client_grad_fn(self.model, self.flat)
         self._needs_server_grad = getattr(self.defense_fn,
@@ -169,7 +187,6 @@ class FederatedExperiment:
         train_test_split(test_size=0.11, stratify=y)); the server
         concatenates them (server.py:62-77).  Returns (meta_x, meta_y) —
         the validation pool a FLTrust/Zeno-style defense can consume."""
-        import numpy as np
         cfg = self.cfg
         shards = np.asarray(self.shards)
         xs = np.asarray(self.dataset.train_x)
@@ -197,22 +214,29 @@ class FederatedExperiment:
         return self.metadata
 
     # ------------------------------------------------------------------
-    def _gather_batches(self, t):
-        """Round-t minibatch for every client: one (n, B) gather
-        (replaces the reference's N host-side DataLoaders, user.py:52-55),
-        plus the in-program train-time augmentation where the reference
-        pipeline has one (CIFAR100, data/augment.py)."""
-        idx = round_batch_indices(self.shards, t, self.cfg.batch_size)
-        xs, ys = self.train_x[idx], self.train_y[idx]
+    def _maybe_augment(self, xs, t):
+        """In-program train-time augmentation where the reference pipeline
+        has one (CIFAR100, data/augment.py)."""
         if self._augment:
             from attacking_federate_learning_tpu.data.augment import (
                 reflect_crop_flip, round_augment_key
             )
             xs = reflect_crop_flip(xs, round_augment_key(self.cfg.seed, t))
-        return xs, ys
+        return xs
 
-    def _compute_grads_impl(self, state: ServerState, t):
-        xs, ys = self._gather_batches(t)
+    def _gather_batches(self, t):
+        """Round-t minibatch for every client: one (n, B) gather from the
+        device-resident dataset (replaces the reference's N host-side
+        DataLoaders, user.py:52-55)."""
+        idx = round_batch_indices(self.shards, t, self.cfg.batch_size)
+        return self.train_x[idx], self.train_y[idx]
+
+    def _compute_grads_impl(self, state: ServerState, t, batches=None):
+        """batches=None gathers from the device-resident dataset; the
+        host-streaming mode (cfg.data_placement='host_stream') passes the
+        round's pre-transferred (xs, ys) instead."""
+        xs, ys = self._gather_batches(t) if batches is None else batches
+        xs = self._maybe_augment(xs, t)
         grads = self._client_grads(state.weights, xs, ys)
         grads = grads.astype(self._grad_dtype)  # bf16 halves HBM at scale
         if self.shardings is not None:
@@ -275,8 +299,8 @@ class FederatedExperiment:
             and self.f > 0 and getattr(self.attacker, "num_std", 1) != 0)
 
         if getattr(self.attacker, "fusable", True):
-            def fused_core(state, t):
-                grads = self._compute_grads_impl(state, t)
+            def fused_core(state, t, batches=None):
+                grads = self._compute_grads_impl(state, t, batches)
                 grads = self.attacker.apply(grads, self.f, ctx_for(state, t))
                 return self._aggregate_impl(state, grads, t), grads
 
@@ -284,8 +308,8 @@ class FederatedExperiment:
                 return jnp.isnan(
                     grads[: self.f].astype(jnp.float32)).any()
 
-            def fused(state, t):
-                new_state, grads = fused_core(state, t)
+            def fused(state, t, batches=None):
+                new_state, grads = fused_core(state, t, batches)
                 diag = (round_diagnostics(grads, new_state, t)
                         if cfg.log_round_stats else {})
                 bad = (crafted_nan(grads) if self._check_attack_nan
@@ -326,12 +350,13 @@ class FederatedExperiment:
     def run_span(self, start: int, count: int) -> ServerState:
         """Run ``count`` rounds [start, start+count) as one scanned device
         program when the attack is fusable; falls back to per-round calls
-        otherwise."""
+        otherwise (staged attacks need host crafting; round diagnostics
+        need every intermediate gradient matrix; host-streamed data feeds
+        one round's batch per program, overlapped with the previous
+        round's compute)."""
         if count <= 0:
             return self.state
-        if self._staged or self.cfg.log_round_stats:
-            # Per-round path: staged attacks need host crafting; round
-            # diagnostics need every intermediate gradient matrix.
+        if self._staged or self.cfg.log_round_stats or self._streaming:
             for t in range(start, start + count):
                 self.run_round(t)
         else:
@@ -343,15 +368,17 @@ class FederatedExperiment:
         return self.state
 
     def run_round(self, t: int) -> ServerState:
+        batches = self.stream.get(int(t)) if self._streaming else None
         t = jnp.asarray(t, jnp.int32)
         self.last_round_stats = None
         if not self._staged:
-            self.state, diag, bad = self._fused_round(self.state, t)
+            self.state, diag, bad = self._fused_round(self.state, t,
+                                                      batches)
             if diag:
                 self.last_round_stats = diag
             self._raise_if_attack_nan(bad)
         else:
-            grads = self._compute_grads(self.state, t)
+            grads = self._compute_grads(self.state, t, batches)
             grads = self.attacker.apply(grads, self.f,
                                         self._ctx_for(self.state, t))
             self.state = self._aggregate(self.state, grads, t)
@@ -396,7 +423,7 @@ class FederatedExperiment:
         # requested, all rounds between eval points run as ONE scanned
         # device program (run_span); eval cadence is identical either way.
         use_spans = (not self._staged and not cfg.log_round_stats
-                     and timer is None)
+                     and timer is None and not self._streaming)
         epoch = int(self.state.round)
         while epoch < cfg.epochs:
             if use_spans:

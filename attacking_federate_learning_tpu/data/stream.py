@@ -1,0 +1,75 @@
+"""Host-resident streaming batch feeder for beyond-HBM datasets.
+
+The default engine path device-puts the whole training set once and gathers
+every round's (n_clients, B) batch on device — ideal while the dataset fits
+HBM (MNIST/CIFAR do).  For FEMNIST-scale corpora (SURVEY.md §7.3 #5) the
+training arrays must stay in host RAM; this feeder gathers each round's
+batch on the host and overlaps the host->device transfer of round t+1 with
+round t's compute:
+
+    xs, ys = stream.get(t)     # returns round t (already on device),
+                               # then issues the async device_put for t+1
+
+``jax.device_put`` is asynchronous on accelerator backends, so the prefetch
+is one round deep with no threads — the same single-slot double buffering a
+tf.data/grain input pipeline would do, minus the dependency.  Round-batch
+semantics are identical to the device path (data/partition.py
+round_batch_indices: cycling wrap-around, static shapes).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+class HostStream:
+    def __init__(self, train_x, train_y, shards, batch_size: int,
+                 plan=None, n_rounds=None):
+        self.x = np.asarray(train_x)
+        self.y = np.asarray(train_y)
+        self.shards = np.asarray(shards)
+        self.batch_size = int(batch_size)
+        # Prefetch horizon: no useless gather/transfer past the last round
+        # (None = unbounded, for open-ended callers).
+        self.n_rounds = n_rounds
+        self._cache: dict = {}
+        self._sharding_x = self._sharding_y = None
+        if plan is not None:
+            # Batches shard over the clients mesh axis when it divides n
+            # (mirroring MeshPlan.place's evenness rule for other arrays).
+            from jax.sharding import PartitionSpec as P
+            from attacking_federate_learning_tpu.parallel.mesh import CLIENTS
+            n = self.shards.shape[0]
+            axis = CLIENTS if n % plan.mesh.shape[CLIENTS] == 0 else None
+            self._sharding_x = plan.sharding(
+                P(*((axis,) + (None,) * self.x.ndim)))
+            self._sharding_y = plan.sharding(P(axis, None))
+
+    # ------------------------------------------------------------------
+    def _host_gather(self, t: int):
+        shard_len = self.shards.shape[1]
+        offs = (t * self.batch_size
+                + np.arange(self.batch_size)) % shard_len
+        idx = self.shards[:, offs]                      # (n, B)
+        return self.x[idx], self.y[idx]
+
+    def _issue(self, t: int):
+        if t in self._cache:
+            return
+        xs, ys = self._host_gather(t)
+        self._cache[t] = (jax.device_put(xs, self._sharding_x),
+                          jax.device_put(ys, self._sharding_y))
+
+    def get(self, t: int):
+        """Device batch for round t; prefetches round t+1 (within the
+        horizon)."""
+        t = int(t)
+        self._issue(t)                    # hit if prefetched, else sync
+        out = self._cache.pop(t)
+        # Drop stale slots (e.g. after a resume jump), keep memory at one
+        # in-flight round.
+        self._cache = {k: v for k, v in self._cache.items() if k == t + 1}
+        if self.n_rounds is None or t + 1 < self.n_rounds:
+            self._issue(t + 1)            # async: overlaps round t compute
+        return out

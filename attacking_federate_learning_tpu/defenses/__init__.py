@@ -3,3 +3,9 @@ from attacking_federate_learning_tpu.defenses.kernels import (  # noqa: F401
 )
 from attacking_federate_learning_tpu.defenses.fltrust import fltrust  # noqa: F401
 from attacking_federate_learning_tpu.defenses.median import median  # noqa: F401
+from attacking_federate_learning_tpu.defenses.geomed import (  # noqa: F401
+    geometric_median
+)
+from attacking_federate_learning_tpu.defenses.normbound import (  # noqa: F401
+    norm_bounded_mean
+)

@@ -1,0 +1,37 @@
+"""Geometric-median aggregation (RFA: Pillutla, Kakade, Harchaoui,
+IEEE TSP 2022) via the smoothed Weiszfeld iteration.
+
+Beyond-reference addition: the geometric median minimizes
+``sum_i ||z - g_i||`` and tolerates up to half the cohort arbitrarily
+corrupted — a stronger estimator than the coordinate-wise median the
+companion module implements.  The smoothed Weiszfeld update
+
+    w_i = 1 / max(eps, ||z - g_i||);  z <- sum_i w_i g_i / sum_i w_i
+
+runs a fixed number of iterations in a ``lax.fori_loop`` (static shapes,
+one jit), entirely in matrix-vector ops that shard over the model axis.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from attacking_federate_learning_tpu.defenses.kernels import DEFENSES
+
+_ITERS = 10
+_EPS = 1e-6
+
+
+@DEFENSES.register("GeoMedian")
+def geometric_median(users_grads, users_count, corrupted_count,
+                     iters: int = _ITERS, eps: float = _EPS):
+    G = users_grads.astype(jnp.float32)
+
+    def step(_, z):
+        dist = jnp.linalg.norm(G - z[None, :], axis=1)
+        w = 1.0 / jnp.maximum(dist, eps)
+        return (w @ G) / jnp.sum(w)
+
+    z0 = jnp.mean(G, axis=0)
+    return lax.fori_loop(0, iters, step, z0)

@@ -1,0 +1,25 @@
+"""Norm-bounding defense (Sun et al., "Can You Really Backdoor Federated
+Learning?", 2019).
+
+Beyond-reference addition targeted at the reference's own backdoor attack:
+every client update is clipped to the cohort's median L2 norm before
+averaging, so a crafted gradient cannot out-weigh honest ones however it
+is scaled — the canonical mitigation for model-replacement/backdoor
+submissions.  One norm per row + a broadcast scale: fully vectorized,
+shards over both mesh axes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from attacking_federate_learning_tpu.defenses.kernels import DEFENSES
+
+
+@DEFENSES.register("NormBound")
+def norm_bounded_mean(users_grads, users_count, corrupted_count):
+    G = users_grads.astype(jnp.float32)
+    norms = jnp.linalg.norm(G, axis=1)
+    bound = jnp.median(norms)
+    scale = jnp.minimum(1.0, bound / jnp.maximum(norms, 1e-12))
+    return jnp.mean(G * scale[:, None], axis=0)

@@ -64,22 +64,24 @@ class MeshPlan(NamedTuple):
     def sharding(self, spec):
         return NamedSharding(self.mesh, spec)
 
-    def place(self, shards, train_x, train_y, state):
-        """Initial placement: client-index matrix sharded over clients,
-        dataset replicated (MNIST/CIFAR fit in HBM; per-device dataset
-        sharding is a host-streaming concern, SURVEY.md §7.3 #5), server
-        state sharded over the model axis."""
-        shards = jax.device_put(shards, self.sharding(P(CLIENTS, None)))
-        train_x = jax.device_put(train_x, self.sharding(P()))
-        train_y = jax.device_put(train_y, self.sharding(P()))
-        # Rank-aware: vectors (weights, velocity) shard over the model axis,
-        # scalars (round counter) replicate.
-        state = jax.tree_util.tree_map(
+    def place_state(self, state):
+        """Rank-aware server-state placement: vectors (weights, velocity)
+        shard over the model axis, scalars (round counter) replicate."""
+        return jax.tree_util.tree_map(
             lambda leaf: jax.device_put(
                 leaf, self.sharding(self.weights_spec(leaf.shape[0])
                                     if leaf.ndim >= 1 else P())),
             state)
-        return shards, train_x, train_y, state
+
+    def place(self, shards, train_x, train_y, state):
+        """Initial placement: client-index matrix sharded over clients,
+        dataset replicated (MNIST/CIFAR fit in HBM; beyond-HBM data stays
+        on host via data/stream.py, SURVEY.md §7.3 #5), server state
+        sharded over the model axis."""
+        shards = jax.device_put(shards, self.sharding(P(CLIENTS, None)))
+        train_x = jax.device_put(train_x, self.sharding(P()))
+        train_y = jax.device_put(train_y, self.sharding(P()))
+        return shards, train_x, train_y, self.place_state(state)
 
     def constrain_grads(self, grads):
         return jax.lax.with_sharding_constraint(

@@ -125,3 +125,9 @@ def test_bulyan_guard_via_cli(tmp_path):
     with pytest.raises(ValueError, match="Bulyan requires"):
         run_cli(tmp_path, ["-n", "10", "-m", "0.24", "-d", "Bulyan"],
                 epochs=2)
+
+
+def test_attack_backdoor_requires_trigger():
+    with pytest.raises(SystemExit):
+        cli.build_parser()  # parser itself fine
+        cli.main(["--attack", "backdoor", "-s", "SYNTH_MNIST", "-e", "1"])

@@ -1,0 +1,165 @@
+"""Beyond-reference attacks (min-max/min-sum, NDSS'21) and defenses
+(geometric median / RFA, norm bounding) — property tests + engine/CLI
+integration."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from attacking_federate_learning_tpu.attacks.minmax import (
+    MinMaxAttack, MinSumAttack
+)
+from attacking_federate_learning_tpu.defenses.geomed import geometric_median
+from attacking_federate_learning_tpu.defenses.normbound import (
+    norm_bounded_mean
+)
+
+
+def grads_for(n, d, seed):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, d)).astype(np.float32)
+
+
+# --------------------------------------------------------------------------
+# min-max / min-sum
+# --------------------------------------------------------------------------
+def _max_pairwise_sq(G):
+    sq = np.sum(G * G, axis=1)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * (G @ G.T)
+    return float(np.maximum(d2, 0).max())
+
+
+def test_minmax_respects_its_constraint_and_is_aggressive():
+    G = grads_for(9, 50, seed=0)
+    crafted = np.asarray(MinMaxAttack().craft(jnp.asarray(G)))
+    budget = _max_pairwise_sq(G)
+    worst = float(np.max(np.sum((G - crafted) ** 2, axis=1)))
+    assert worst <= budget * (1 + 1e-4)          # constraint holds
+    # gamma was actually pushed: crafted sits away from the plain mean
+    mean = G.mean(axis=0)
+    assert np.linalg.norm(crafted - mean) > 0.5 * np.sqrt(budget) / 2
+
+
+def test_minsum_respects_its_constraint():
+    G = grads_for(11, 40, seed=1)
+    crafted = np.asarray(MinSumAttack().craft(jnp.asarray(G)))
+    sq = np.sum(G * G, axis=1)
+    d2 = np.maximum(sq[:, None] + sq[None, :] - 2.0 * (G @ G.T), 0)
+    budget = float(d2.sum(axis=1).max())
+    total = float(np.sum(np.sum((G - crafted) ** 2, axis=1)))
+    assert total <= budget * (1 + 1e-4)
+
+
+@pytest.mark.parametrize("cls", [MinMaxAttack, MinSumAttack])
+def test_minmax_family_is_fusable_and_jits(cls):
+    G = grads_for(8, 30, seed=2)
+    atk = cls()
+    assert getattr(atk, "fusable", True)
+    out = jax.jit(atk.craft)(jnp.asarray(G))
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(atk.craft(jnp.asarray(G))),
+                               atol=1e-5)
+
+
+def test_minmax_gamma_is_near_tight():
+    # The bisection should land gamma at the constraint boundary: growing
+    # it a few percent must violate the budget.
+    G = grads_for(10, 60, seed=3)
+    atk = MinMaxAttack()
+    Gj = jnp.asarray(G)
+    mean = G.mean(axis=0)
+    crafted = np.asarray(atk.craft(Gj))
+    gamma_dir = crafted - mean
+    budget = _max_pairwise_sq(G)
+    pushed = mean + 1.05 * gamma_dir
+    worst = float(np.max(np.sum((G - pushed) ** 2, axis=1)))
+    assert worst > budget
+
+
+# --------------------------------------------------------------------------
+# geometric median
+# --------------------------------------------------------------------------
+def test_geomed_beats_mean_under_outlier():
+    G = grads_for(12, 40, seed=4)
+    G[0] = 1e4  # one wild outlier
+    gm = np.asarray(geometric_median(jnp.asarray(G), 12, 1))
+    mean = G.mean(axis=0)
+    honest_center = G[1:].mean(axis=0)
+    assert (np.linalg.norm(gm - honest_center)
+            < np.linalg.norm(mean - honest_center) / 100)
+
+
+def test_geomed_reduces_objective_vs_mean():
+    G = grads_for(15, 30, seed=5)
+    gm = np.asarray(geometric_median(jnp.asarray(G), 15, 3))
+
+    def obj(z):
+        return float(np.sum(np.linalg.norm(G - z, axis=1)))
+
+    assert obj(gm) <= obj(G.mean(axis=0)) + 1e-4
+
+
+def test_geomed_exact_on_collinear_points():
+    # 1-D geometric median == the (coordinate) median.
+    G = np.zeros((5, 3), np.float32)
+    G[:, 0] = [0.0, 1.0, 2.0, 3.0, 100.0]
+    gm = np.asarray(geometric_median(jnp.asarray(G), 5, 1, iters=200))
+    assert abs(gm[0] - 2.0) < 0.05
+
+
+# --------------------------------------------------------------------------
+# norm bounding
+# --------------------------------------------------------------------------
+def test_normbound_caps_scaled_rows():
+    G = grads_for(10, 25, seed=6)
+    big = G.copy()
+    big[0] *= 1e6                      # model-replacement-style scaling
+    out_small = np.asarray(norm_bounded_mean(jnp.asarray(G), 10, 1))
+    out_big = np.asarray(norm_bounded_mean(jnp.asarray(big), 10, 1))
+    # The scaled row contributes only a direction, not 1e6x magnitude.
+    assert np.linalg.norm(out_big - out_small) < np.linalg.norm(out_small)
+
+
+def test_normbound_identity_when_norms_equal():
+    G = grads_for(8, 16, seed=7)
+    G = G / np.linalg.norm(G, axis=1, keepdims=True)  # equal norms
+    out = np.asarray(norm_bounded_mean(jnp.asarray(G), 8, 1))
+    np.testing.assert_allclose(out, G.mean(axis=0), atol=1e-6)
+
+
+# --------------------------------------------------------------------------
+# integration: registries, engine rounds, CLI choices
+# --------------------------------------------------------------------------
+@pytest.mark.parametrize("attack", ["minmax", "minsum"])
+@pytest.mark.parametrize("defense", ["GeoMedian", "NormBound"])
+def test_engine_round_with_extensions(attack, defense):
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.attacks import make_attacker
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+
+    cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=8,
+                           mal_prop=0.25, batch_size=16, epochs=2,
+                           defense=defense, synth_train=256, synth_test=64)
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=256, synth_test=64)
+    exp = FederatedExperiment(
+        cfg, attacker=make_attacker(cfg, dataset=ds, name=attack),
+        dataset=ds)
+    exp.run_span(0, 2)   # fused span: the attacks must trace cleanly
+    assert np.all(np.isfinite(np.asarray(exp.state.weights)))
+
+
+def test_cli_accepts_extension_choices(tmp_path):
+    from attacking_federate_learning_tpu import cli
+
+    result = cli.main(["-s", "SYNTH_MNIST", "-e", "2", "-c", "16",
+                       "-n", "8", "-m", "0.25", "-d", "GeoMedian",
+                       "--attack", "minmax",
+                       "--synth-train", "256", "--synth-test", "64",
+                       "--log-dir", str(tmp_path / "logs"),
+                       "--run-dir", str(tmp_path / "runs")])
+    assert len(result["accuracies"]) >= 1
