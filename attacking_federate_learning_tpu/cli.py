@@ -55,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
                    type=int)
     p.add_argument("-c", "--batch_size", default=128, type=int)
     p.add_argument("-e", "--epochs", default=300, type=int)
+    p.add_argument("--local-steps", default=1, type=int,
+                   help="FedAvg-style local SGD steps per round (1 = the "
+                        "reference's FedSGD; k>1 reports (w0-w_k)/lr as "
+                        "the wire gradient)")
     p.add_argument("-l", "--learning_rate", default=0.1, type=float)
     p.add_argument("-o", "--output", type=str,
                    help="output file for results (tee)")
@@ -144,6 +148,7 @@ def config_from_args(args) -> ExperimentConfig:
         learning_rate=args.learning_rate,
         batch_size=args.batch_size,
         epochs=args.epochs,
+        local_steps=args.local_steps,
         num_std=args.num_std,
         backdoor=args.backdoor,
         defense=args.defense,

@@ -44,6 +44,12 @@ class ExperimentConfig:
     momentum: float = 0.9            # reference main.py:138
     batch_size: int = 128            # reference main.py:121
     epochs: int = 300                # rounds, reference main.py:124
+    # FedAvg-style local SGD steps per round (beyond-reference; the
+    # reference is strictly FedSGD — its client optimizer never steps,
+    # user.py:80).  k > 1 clients run k local steps at the faded lr and
+    # report (w0 - w_k)/lr, wire-compatible with a gradient
+    # (core/client.py:make_client_update_fn).
+    local_steps: int = 1
 
     # --- attack ---------------------------------------------------------
     num_std: float = 1.5             # ALIE z, reference main.py:109
@@ -165,6 +171,9 @@ class ExperimentConfig:
             raise ValueError(
                 f"data_placement must be 'device' or 'host_stream', "
                 f"got {self.data_placement!r}")
+        if self.local_steps < 1:
+            raise ValueError(
+                f"local_steps must be >= 1, got {self.local_steps}")
         if self.fading_rate is None:
             self.fading_rate = FADING_RATES.get(self.dataset, 10000.0)
         if self.model is None:

@@ -41,3 +41,48 @@ def make_client_grad_fn(model: Model, flat: FlatParams):
         return jax.vmap(grad_fn, in_axes=(None, 0, 0))(flat_w, xs, ys)
 
     return clients_grads
+
+
+def make_client_update_fn(model: Model, flat: FlatParams,
+                          local_steps: int = 1):
+    """FedAvg-style local training (beyond-reference: the reference is
+    strictly FedSGD — one minibatch gradient, never a local optimizer
+    step, user.py:80).
+
+    With ``local_steps == 1`` this IS :func:`make_client_grad_fn` (exact
+    reference semantics, lr-independent).  With k > 1 each client runs k
+    plain-SGD steps at the dispatched (faded) ``lr_train`` and reports the
+    pseudo-gradient ``(w0 - w_k) / lr_report``, where ``lr_report`` is the
+    lr the *server* will multiply back in — the FedAvg-as-FedSGD reduction
+    is exact only when the divisor matches the server's multiplier (which,
+    reference quirk, is the constant base lr while clients fade,
+    reference server.py:89 vs :50-52).
+
+    Signature: (d,), (n, k, B, ...), (n, k, B), lr_train, lr_report
+    -> (n, d).
+    """
+    if local_steps == 1:
+        base = make_client_grad_fn(model, flat)
+
+        def clients_update(flat_w, xs, ys, lr_train, lr_report):
+            # Squeeze the k=1 step axis; lrs are unused (parity: the
+            # reference's client optimizer never steps).
+            return base(flat_w, xs[:, 0], ys[:, 0])
+
+        return clients_update
+
+    grad_fn = jax.grad(make_loss_fn(model, flat))
+
+    def one_client(flat_w, xs, ys, lr_train, lr_report):
+        def step(w, batch):
+            x, y = batch
+            return w - lr_train * grad_fn(w, x, y), None
+
+        wk, _ = jax.lax.scan(step, flat_w, (xs, ys))
+        return (flat_w - wk) / lr_report
+
+    def clients_update(flat_w, xs, ys, lr_train, lr_report):
+        return jax.vmap(one_client, in_axes=(None, 0, 0, None, None))(
+            flat_w, xs, ys, lr_train, lr_report)
+
+    return clients_update

@@ -32,7 +32,7 @@ from attacking_federate_learning_tpu.attacks.base import (
 )
 from attacking_federate_learning_tpu.config import ExperimentConfig
 from attacking_federate_learning_tpu.core.client import (
-    make_client_grad_fn, make_loss_fn
+    make_client_update_fn, make_loss_fn
 )
 from attacking_federate_learning_tpu.core.evaluate import make_eval_fn
 from attacking_federate_learning_tpu.core.server import (
@@ -89,8 +89,8 @@ class FederatedExperiment:
             self.train_x = self.train_y = None
             self.stream = HostStream(self.dataset.train_x,
                                      self.dataset.train_y, shards,
-                                     cfg.batch_size, plan=shardings,
-                                     n_rounds=cfg.epochs)
+                                     cfg.batch_size * cfg.local_steps,
+                                     plan=shardings, n_rounds=cfg.epochs)
             if shardings is not None:
                 self.state = shardings.place_state(self.state)
         else:
@@ -112,7 +112,8 @@ class FederatedExperiment:
                 f"data_augment needs (N, C, H, W) images, got "
                 f"shape {np.shape(self.dataset.train_x)} for {cfg.dataset}")
         self._grad_dtype = jnp.dtype(cfg.grad_dtype)
-        self._client_grads = make_client_grad_fn(self.model, self.flat)
+        self._client_update = make_client_update_fn(self.model, self.flat,
+                                                    cfg.local_steps)
         self._needs_server_grad = getattr(self.defense_fn,
                                           "needs_server_grad", False)
         self.metadata = (self.collect_metadata()
@@ -225,19 +226,34 @@ class FederatedExperiment:
         return xs
 
     def _gather_batches(self, t):
-        """Round-t minibatch for every client: one (n, B) gather from the
-        device-resident dataset (replaces the reference's N host-side
-        DataLoaders, user.py:52-55)."""
-        idx = round_batch_indices(self.shards, t, self.cfg.batch_size)
+        """Round-t minibatches for every client: one (n, k*B) gather from
+        the device-resident dataset (replaces the reference's N host-side
+        DataLoaders, user.py:52-55); k = local_steps (1 in the reference's
+        FedSGD regime)."""
+        idx = round_batch_indices(
+            self.shards, t, self.cfg.batch_size * self.cfg.local_steps)
         return self.train_x[idx], self.train_y[idx]
 
     def _compute_grads_impl(self, state: ServerState, t, batches=None):
         """batches=None gathers from the device-resident dataset; the
         host-streaming mode (cfg.data_placement='host_stream') passes the
         round's pre-transferred (xs, ys) instead."""
+        cfg = self.cfg
         xs, ys = self._gather_batches(t) if batches is None else batches
         xs = self._maybe_augment(xs, t)
-        grads = self._client_grads(state.weights, xs, ys)
+        # Split the flat (n, k*B) gather into k local-step minibatches.
+        k, B = cfg.local_steps, cfg.batch_size
+        xs = xs.reshape((self.n, k, B) + xs.shape[2:])
+        ys = ys.reshape((self.n, k, B))
+        # Clients train at the faded lr the server dispatches (reference
+        # server.py:50-52; inert at k=1, user.py:80); the pseudo-gradient
+        # divides by the lr the server will multiply back in so the
+        # FedAvg reduction is exact under the constant-server-lr quirk.
+        lr_train = faded_learning_rate(cfg.learning_rate, cfg.fading_rate, t)
+        lr_report = (lr_train if cfg.server_uses_faded_lr
+                     else cfg.learning_rate)
+        grads = self._client_update(state.weights, xs, ys, lr_train,
+                                    lr_report)
         grads = grads.astype(self._grad_dtype)  # bf16 halves HBM at scale
         if self.shardings is not None:
             grads = self.shardings.constrain_grads(grads)

@@ -22,9 +22,19 @@ from attacking_federate_learning_tpu import config as C
 from attacking_federate_learning_tpu.config import ExperimentConfig
 
 
-DEFENSES_ALL = ["NoDefense", "Krum", "TrimmedMean", "Bulyan", "Median",
-                "FLTrust"]
-ATTACKS_ALL = ["none", "alie", "backdoor", "signflip", "noise"]
+def _all_defenses():
+    # Derived from the registry so new defenses join the sweep on
+    # registration (names() is sorted; keep NoDefense first as the
+    # baseline column).
+    from attacking_federate_learning_tpu.defenses import DEFENSES
+    names = DEFENSES.names()
+    return ["NoDefense"] + [n for n in names if n != "NoDefense"]
+
+
+def _all_attacks():
+    from attacking_federate_learning_tpu.attacks import ATTACKS
+    names = ATTACKS.names()
+    return ["none"] + [n for n in names if n != "none"]
 
 
 def run_grid(base: ExperimentConfig, defenses=None, attacks=None,
@@ -36,8 +46,8 @@ def run_grid(base: ExperimentConfig, defenses=None, attacks=None,
     from attacking_federate_learning_tpu.data.datasets import load_dataset
     from attacking_federate_learning_tpu.utils.metrics import RunLogger
 
-    defenses = defenses or DEFENSES_ALL
-    attacks = attacks or ATTACKS_ALL
+    defenses = defenses or _all_defenses()
+    attacks = attacks or _all_attacks()
     dataset = load_dataset(base.dataset, base.data_dir, base.seed,
                            synth_train=base.synth_train,
                            synth_test=base.synth_test)
