@@ -82,6 +82,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="JAX platform; must be chosen before jax initializes")
     p.add_argument("--mesh-shape", default=None, type=str,
                    help="'clients,model' device split, e.g. 8,1")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize client activations in the backward "
+                        "pass (jax.checkpoint) — trades FLOPs for HBM at "
+                        "WRN/large-cohort scale")
     p.add_argument("--data-placement", default="device",
                    choices=["device", "host_stream"],
                    help="'device' holds the training set in HBM; "
@@ -162,6 +166,7 @@ def config_from_args(args) -> ExperimentConfig:
         backend=args.backend,
         mesh_shape=mesh_shape,
         data_placement=args.data_placement,
+        remat=args.remat,
         krum_paper_scoring=args.krum_paper_scoring,
         krum_scoring_method=args.krum_scoring_method,
         distance_impl=args.distance_impl,

@@ -115,6 +115,10 @@ class ExperimentConfig:
     grad_dtype: str = "float32"      # dtype of the (n, d) gradient matrix;
                                      # 'bfloat16' halves HBM at large n
                                      # (distances still accumulate in f32)
+    # jax.checkpoint the client loss: backward recomputes activations
+    # instead of storing (n, B, activations) — the HBM/FLOPs trade for
+    # WRN-scale models or very large cohorts (core/client.py).
+    remat: bool = False
 
     # --- reference-parity quirk flags (SURVEY.md §2.4) ------------------
     # Server momentum step uses the *constant* base lr, not the faded lr

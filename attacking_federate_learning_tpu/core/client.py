@@ -22,20 +22,26 @@ from attacking_federate_learning_tpu.models.layers import nll_loss
 from attacking_federate_learning_tpu.utils.flatten import FlatParams
 
 
-def make_loss_fn(model: Model, flat: FlatParams):
+def make_loss_fn(model: Model, flat: FlatParams, remat: bool = False):
     """Mean-NLL loss on flat wire-format weights (reference user.py:36,
-    :77-79: log_softmax head + NLLLoss)."""
+    :77-79: log_softmax head + NLLLoss).
+
+    ``remat=True`` wraps the loss in ``jax.checkpoint`` so the backward
+    pass recomputes activations instead of storing them — the standard
+    HBM/FLOPs trade for big models (WRN-40-4) or big client cohorts,
+    where the vmapped (n, B, activations) footprint dominates memory.
+    """
 
     def loss_fn(flat_w, x, y):
         params = flat.unravel(flat_w)
         return nll_loss(model.apply(params, x), y)
 
-    return loss_fn
+    return jax.checkpoint(loss_fn) if remat else loss_fn
 
 
-def make_client_grad_fn(model: Model, flat: FlatParams):
+def make_client_grad_fn(model: Model, flat: FlatParams, remat: bool = False):
     """(d,), (n, B, ...), (n, B) -> (n, d) per-client gradients."""
-    grad_fn = jax.grad(make_loss_fn(model, flat))
+    grad_fn = jax.grad(make_loss_fn(model, flat, remat))
 
     def clients_grads(flat_w, xs, ys):
         return jax.vmap(grad_fn, in_axes=(None, 0, 0))(flat_w, xs, ys)
@@ -44,7 +50,7 @@ def make_client_grad_fn(model: Model, flat: FlatParams):
 
 
 def make_client_update_fn(model: Model, flat: FlatParams,
-                          local_steps: int = 1):
+                          local_steps: int = 1, remat: bool = False):
     """FedAvg-style local training (beyond-reference: the reference is
     strictly FedSGD — one minibatch gradient, never a local optimizer
     step, user.py:80).
@@ -62,7 +68,7 @@ def make_client_update_fn(model: Model, flat: FlatParams,
     -> (n, d).
     """
     if local_steps == 1:
-        base = make_client_grad_fn(model, flat)
+        base = make_client_grad_fn(model, flat, remat)
 
         def clients_update(flat_w, xs, ys, lr_train, lr_report):
             # Squeeze the k=1 step axis; lrs are unused (parity: the
@@ -71,7 +77,7 @@ def make_client_update_fn(model: Model, flat: FlatParams,
 
         return clients_update
 
-    grad_fn = jax.grad(make_loss_fn(model, flat))
+    grad_fn = jax.grad(make_loss_fn(model, flat, remat))
 
     def one_client(flat_w, xs, ys, lr_train, lr_report):
         def step(w, batch):

@@ -65,6 +65,7 @@ class FederatedExperiment:
             from attacking_federate_learning_tpu.parallel.mesh import make_plan
             shardings = make_plan(tuple(cfg.mesh_shape))
         self.shardings = shardings  # parallel.MeshPlan or None (single device)
+        self._krum_select_fn = None  # set for Krum (selection telemetry)
         self.defense_fn = DEFENSES[cfg.defense]
         if cfg.defense in ("Krum", "Bulyan"):
             self.defense_fn = self._wire_distance_defense(self.defense_fn)
@@ -113,7 +114,8 @@ class FederatedExperiment:
                 f"shape {np.shape(self.dataset.train_x)} for {cfg.dataset}")
         self._grad_dtype = jnp.dtype(cfg.grad_dtype)
         self._client_update = make_client_update_fn(self.model, self.flat,
-                                                    cfg.local_steps)
+                                                    cfg.local_steps,
+                                                    remat=cfg.remat)
         self._needs_server_grad = getattr(self.defense_fn,
                                           "needs_server_grad", False)
         self.metadata = (self.collect_metadata()
@@ -176,8 +178,21 @@ class FederatedExperiment:
                 D = dist_fn(grads.astype(jnp.float32), mesh)
                 return _fn(grads, n, f, D=D, **extra)
 
+            if cfg.defense == "Krum":
+                from attacking_federate_learning_tpu.defenses.kernels import (
+                    krum_select
+                )
+                self._krum_select_fn = functools.partial(
+                    with_blockwise_D, _fn=krum_select, **kw)
             return functools.partial(with_blockwise_D, **kw)
         kw["distance_impl"] = impl
+        if cfg.defense == "Krum":
+            # Selection telemetry shares the defense's exact knobs, so the
+            # reported winner IS the aggregated client (round_diagnostics).
+            from attacking_federate_learning_tpu.defenses.kernels import (
+                krum_select
+            )
+            self._krum_select_fn = functools.partial(krum_select, **kw)
         return functools.partial(fn, **kw)
 
     # ------------------------------------------------------------------
@@ -259,14 +274,18 @@ class FederatedExperiment:
             grads = self.shardings.constrain_grads(grads)
         return grads
 
-    def _aggregate_impl(self, state: ServerState, grads, t):
-        if self._needs_server_grad:
-            server_grad = jax.grad(make_loss_fn(self.model, self.flat))(
-                state.weights, self._meta_x, self._meta_y)
-            agg = self.defense_fn(grads, self.n, self.f,
-                                  server_grad=server_grad)
-        else:
-            agg = self.defense_fn(grads, self.n, self.f)
+    def _aggregate_impl(self, state: ServerState, grads, t, agg=None):
+        """``agg`` pre-empts the defense call — the Krum-telemetry round
+        computes the selection once and aggregates ``grads[sel]`` rather
+        than running the O(n^2 d) distance engine twice."""
+        if agg is None:
+            if self._needs_server_grad:
+                server_grad = jax.grad(make_loss_fn(self.model, self.flat))(
+                    state.weights, self._meta_x, self._meta_y)
+                agg = self.defense_fn(grads, self.n, self.f,
+                                      server_grad=server_grad)
+            else:
+                agg = self.defense_fn(grads, self.n, self.f)
         agg = agg.astype(jnp.float32)
         if self.cfg.server_uses_faded_lr:
             lr = faded_learning_rate(self.cfg.learning_rate,
@@ -289,11 +308,14 @@ class FederatedExperiment:
 
         self._ctx_for = ctx_for  # single construction site for the seam
 
-        def round_diagnostics(grads, state_after, t):
+        def round_diagnostics(grads, state_after, t, aux=None):
             """Per-round stats (SURVEY.md §5 rebuild item): client gradient
-            norm spread, aggregate step norm, faded lr."""
+            norm spread, aggregate step norm, faded lr — plus, under Krum,
+            which client won selection and whether it was malicious (the
+            selection-histogram observability the reference lacks; ``aux``
+            carries the selection the defense actually made)."""
             norms = jnp.linalg.norm(grads.astype(jnp.float32), axis=1)
-            return {
+            diag = {
                 "grad_norm_mean": jnp.mean(norms),
                 "grad_norm_max": jnp.max(norms),
                 "grad_norm_min": jnp.min(norms),
@@ -301,6 +323,12 @@ class FederatedExperiment:
                 "faded_lr": faded_learning_rate(cfg.learning_rate,
                                                 cfg.fading_rate, t),
             }
+            if aux and "krum_selected" in aux:
+                sel = aux["krum_selected"]
+                diag["krum_selected"] = sel
+                diag["malicious_selected"] = (sel < self.f).astype(
+                    jnp.int32)
+            return diag
 
         self._round_diagnostics = round_diagnostics
 
@@ -314,19 +342,32 @@ class FederatedExperiment:
             getattr(self.attacker, "checks_finite", False)
             and self.f > 0 and getattr(self.attacker, "num_std", 1) != 0)
 
+        # Selection telemetry: compute the Krum winner ONCE and aggregate
+        # grads[sel] (krum == grads[krum_select], defenses/kernels.py) —
+        # the O(n^2 d) distance engine never runs twice per round.
+        diag_select = (self._krum_select_fn if cfg.log_round_stats
+                       else None)
+
         if getattr(self.attacker, "fusable", True):
             def fused_core(state, t, batches=None):
                 grads = self._compute_grads_impl(state, t, batches)
                 grads = self.attacker.apply(grads, self.f, ctx_for(state, t))
-                return self._aggregate_impl(state, grads, t), grads
+                aux = {}
+                agg = None
+                if diag_select is not None:
+                    sel = diag_select(grads, self.n, self.f)
+                    aux["krum_selected"] = sel
+                    agg = grads[sel]
+                new_state = self._aggregate_impl(state, grads, t, agg=agg)
+                return new_state, grads, aux
 
             def crafted_nan(grads):
                 return jnp.isnan(
                     grads[: self.f].astype(jnp.float32)).any()
 
             def fused(state, t, batches=None):
-                new_state, grads = fused_core(state, t, batches)
-                diag = (round_diagnostics(grads, new_state, t)
+                new_state, grads, aux = fused_core(state, t, batches)
+                diag = (round_diagnostics(grads, new_state, t, aux)
                         if cfg.log_round_stats else {})
                 bad = (crafted_nan(grads) if self._check_attack_nan
                        else jnp.asarray(False))
@@ -340,7 +381,7 @@ class FederatedExperiment:
                 # so every span length shares one compilation.
                 def body(i, carry):
                     s, bad = carry
-                    s2, grads = fused_core(s, t0 + i)
+                    s2, grads, _ = fused_core(s, t0 + i)
                     if self._check_attack_nan:
                         bad = bad | crafted_nan(grads)
                     return s2, bad
@@ -397,10 +438,19 @@ class FederatedExperiment:
             grads = self._compute_grads(self.state, t, batches)
             grads = self.attacker.apply(grads, self.f,
                                         self._ctx_for(self.state, t))
-            self.state = self._aggregate(self.state, grads, t)
+            aux = {}
+            agg = None
+            if self.cfg.log_round_stats and self._krum_select_fn is not None:
+                # Eager selection (same knobs as the defense), aggregate
+                # the selected row directly — single distance computation,
+                # same as the fused path.
+                sel = self._krum_select_fn(grads, self.n, self.f)
+                aux["krum_selected"] = sel
+                agg = grads[sel]
+            self.state = self._aggregate(self.state, grads, t, agg)
             if self.cfg.log_round_stats:
                 self.last_round_stats = self._round_diagnostics(
-                    grads, self.state, t)
+                    grads, self.state, t, aux)
         return self.state
 
     def run(self, logger: Optional[RunLogger] = None,

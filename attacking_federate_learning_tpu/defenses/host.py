@@ -67,8 +67,9 @@ def _scores(D, pool, f, alive, paper_scoring=False):
     return scores
 
 
-def host_krum(G, users_count, corrupted_count, paper_scoring=False):
-    """Krum winner row (reference defences.py:23-42 semantics).
+def host_krum_index(G, users_count, corrupted_count, paper_scoring=False):
+    """Krum winner index (reference defences.py:23-42 semantics,
+    ``return_index=True`` shape).
 
     Selection of the k nearest peers happens on *squared* distances
     (monotone in the true distance), so the sqrt runs only over the n*k
@@ -80,10 +81,17 @@ def host_krum(G, users_count, corrupted_count, paper_scoring=False):
     k = users_count - corrupted_count - (2 if paper_scoring else 0)
     k = max(min(k, n - 1), 0)
     if k == 0:
-        return G[0]
+        return 0
     part = np.partition(d2, k - 1, axis=1)[:, :k]
     scores = np.sqrt(part, out=part).sum(axis=1)
-    return G[int(np.argmin(scores))]
+    return int(np.argmin(scores))
+
+
+def host_krum(G, users_count, corrupted_count, paper_scoring=False):
+    """Krum winner row."""
+    G = np.asarray(G, np.float32)
+    return G[host_krum_index(G, users_count, corrupted_count,
+                             paper_scoring=paper_scoring)]
 
 
 def host_trimmed_mean_of(sel: np.ndarray, number_to_consider: int):

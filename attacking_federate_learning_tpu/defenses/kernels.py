@@ -154,6 +154,48 @@ def _krum_scores(D, users_count, corrupted_count, alive=None,
     return scores
 
 
+def _host_krum_index(users_grads, users_count, corrupted_count,
+                     paper_scoring):
+    """Host-BLAS Krum index; pure_callback (scalar int out) under trace,
+    zero-copy eager otherwise — same dispatch contract as _host_defense."""
+    import numpy as np
+
+    from attacking_federate_learning_tpu.defenses.host import (
+        host_krum_index
+    )
+
+    n_static, f_static = int(users_count), int(corrupted_count)
+
+    def cb(g):
+        return np.int32(host_krum_index(np.asarray(g, np.float32),
+                                        n_static, f_static,
+                                        paper_scoring=paper_scoring))
+
+    if not isinstance(users_grads, jax.core.Tracer):
+        return jnp.asarray(cb(users_grads))
+    return jax.pure_callback(cb, jax.ShapeDtypeStruct((), jnp.int32),
+                             users_grads.astype(jnp.float32))
+
+
+def krum_select(users_grads, users_count, corrupted_count,
+                paper_scoring=False, method="sort", distance_impl="xla",
+                D=None):
+    """Index of the Krum winner (reference ``krum(..., return_index=True)``,
+    defences.py:39-40).  :func:`krum` is defined through this, so the
+    selection the engine's round diagnostics report is — by construction —
+    the client the defense aggregated, for every distance engine."""
+    if D is None:
+        impl = resolve_distance_impl(distance_impl, users_count,
+                                     users_grads)
+        if impl == "host":
+            return _host_krum_index(users_grads, users_count,
+                                    corrupted_count, paper_scoring)
+        D = _distances_for(users_grads, impl)
+    scores = _krum_scores(D, users_count, corrupted_count,
+                          paper_scoring=paper_scoring, method=method)
+    return jnp.argmin(scores)
+
+
 @DEFENSES.register("Krum")
 def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
          method="sort", distance_impl="xla", D=None):
@@ -167,17 +209,11 @@ def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
     with zero diagonal — the engine passes one from the blockwise shard_map
     kernels (parallel/distances.py) for distance_impl in {ring, allgather}.
     """
-    if D is None:
-        impl = resolve_distance_impl(distance_impl, users_count,
-                                     users_grads)
-        if impl == "host":
-            from attacking_federate_learning_tpu.defenses.host import host_krum
-            return _host_defense(host_krum, users_grads, users_count,
-                                 corrupted_count, paper_scoring)
-        D = _distances_for(users_grads, impl)
-    scores = _krum_scores(D, users_count, corrupted_count,
-                          paper_scoring=paper_scoring, method=method)
-    return users_grads[jnp.argmin(scores)]
+    return users_grads[krum_select(users_grads, users_count,
+                                   corrupted_count,
+                                   paper_scoring=paper_scoring,
+                                   method=method,
+                                   distance_impl=distance_impl, D=D)]
 
 
 def trimmed_mean_of(users_grads, number_to_consider):

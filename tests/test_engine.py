@@ -254,3 +254,84 @@ def test_fused_backdoor_nan_guard_fires():
         exp.run_span(0, 2)
         # belt & braces: some overflows surface one span later
         exp.run_span(2, 2)
+
+
+def test_round_stats_report_krum_selection():
+    """Under Krum with --round-stats, the diagnostics carry the selected
+    client index and a malicious-selected flag (reference
+    krum(return_index=True), defences.py:39-40, promoted to telemetry)."""
+    import numpy as np
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.attacks import DriftAttack
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+    from attacking_federate_learning_tpu.defenses.kernels import krum_select
+
+    cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=9,
+                           mal_prop=0.22, batch_size=16, epochs=2,
+                           defense="Krum", log_round_stats=True,
+                           synth_train=256, synth_test=64)
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=256, synth_test=64)
+    exp = FederatedExperiment(cfg, attacker=DriftAttack(1.0), dataset=ds)
+    exp.run_round(0)
+    stats = exp.last_round_stats
+    sel = int(stats["krum_selected"])
+    assert 0 <= sel < 9
+    assert int(stats["malicious_selected"]) == (1 if sel < exp.f else 0)
+
+    # The reported index must be the actual Krum winner of the round's
+    # (post-attack) gradient matrix — checked on round 1, whose input
+    # weights are the current state.
+    g1 = exp._compute_grads_impl(exp.state, 1)
+    g1 = exp.attacker.apply(g1, exp.f, exp._ctx_for(exp.state, 1))
+    want = int(krum_select(g1, 9, exp.f))
+    exp.run_round(1)
+    assert int(exp.last_round_stats["krum_selected"]) == want
+
+
+def test_krum_selection_telemetry_matches_defense_impl():
+    """The telemetry must use the defense's own distance engine: under
+    distance_impl='allgather' (blockwise shard_map) the reported winner
+    still matches the aggregated row."""
+    import numpy as np
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.attacks import DriftAttack
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+
+    cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=16,
+                           mal_prop=0.2, batch_size=16, epochs=1,
+                           defense="Krum", log_round_stats=True,
+                           distance_impl="allgather", mesh_shape=(8, 1),
+                           synth_train=256, synth_test=64)
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=256, synth_test=64)
+    exp = FederatedExperiment(cfg, attacker=DriftAttack(1.0), dataset=ds)
+    exp.run_round(0)
+    sel = int(exp.last_round_stats["krum_selected"])
+    assert 0 <= sel < 16
+
+
+def test_krum_select_host_under_jit():
+    """Explicit distance_impl='host' on a traced operand must route
+    through the scalar-index pure_callback, not crash on np.asarray."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from attacking_federate_learning_tpu.defenses.kernels import (
+        krum, krum_select
+    )
+
+    rng = np.random.default_rng(0)
+    G = jnp.asarray(rng.standard_normal((9, 30)).astype(np.float32))
+    fn = jax.jit(lambda g: krum_select(g, 9, 2, distance_impl="host"))
+    want = int(krum_select(G, 9, 2, distance_impl="xla"))
+    assert int(fn(G)) == want
+    row = jax.jit(lambda g: krum(g, 9, 2, distance_impl="host"))(G)
+    np.testing.assert_allclose(np.asarray(row), np.asarray(G[want]),
+                               atol=0)

@@ -162,3 +162,11 @@ def test_cli_choices_match_registries():
     actions = {a.dest: a for a in parser._actions}
     assert set(actions["defense"].choices) == set(DEFENSES.names())
     assert set(actions["attack"].choices) == {"auto"} | set(ATTACKS.names())
+
+
+def test_remat_grads_identical():
+    """jax.checkpoint must not change values — only the backward's memory
+    schedule."""
+    a = _weights(rounds=2, remat=True)
+    b = _weights(rounds=2, remat=False)
+    np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-6)
