@@ -55,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
                    type=int)
     p.add_argument("-c", "--batch_size", default=128, type=int)
     p.add_argument("-e", "--epochs", default=300, type=int)
+    p.add_argument("--participation", default=1.0, type=float,
+                   help="fraction of clients sampled each round (static "
+                        "cohort sizes, random identities; 1.0 = the "
+                        "reference's everyone-every-round)")
     p.add_argument("--local-steps", default=1, type=int,
                    help="FedAvg-style local SGD steps per round (1 = the "
                         "reference's FedSGD; k>1 reports (w0-w_k)/lr as "
@@ -153,6 +157,7 @@ def config_from_args(args) -> ExperimentConfig:
         batch_size=args.batch_size,
         epochs=args.epochs,
         local_steps=args.local_steps,
+        participation=args.participation,
         num_std=args.num_std,
         backdoor=args.backdoor,
         defense=args.defense,

@@ -96,6 +96,14 @@ class ExperimentConfig:
                                      # reference user.py:49-54) | 'dirichlet'
     dirichlet_alpha: float = 0.5
 
+    # --- per-round client participation (beyond-reference) -------------
+    # Fraction of clients sampled each round (the reference uses every
+    # client every round).  Cohort sizes are STATIC — round(p*f) malicious
+    # + the honest remainder — with random identities per round, so jit
+    # shapes never change and the rows-[0, f_round) attack invariant
+    # holds (core/engine.py:_participants).
+    participation: float = 1.0
+
     # --- train-time augmentation ---------------------------------------
     # Reference parity: only the CIFAR100 train pipeline augments
     # (reflect-pad 4 + RandomCrop(32) + RandomHorizontalFlip, reference
@@ -178,6 +186,10 @@ class ExperimentConfig:
         if self.local_steps < 1:
             raise ValueError(
                 f"local_steps must be >= 1, got {self.local_steps}")
+        if not (0.0 < self.participation <= 1.0):
+            raise ValueError(
+                f"participation must be in (0, 1], got "
+                f"{self.participation}")
         if self.fading_rate is None:
             self.fading_rate = FADING_RATES.get(self.dataset, 10000.0)
         if self.model is None:

@@ -60,7 +60,31 @@ class FederatedExperiment:
         self.model = get_model(cfg.model)
         self.n = cfg.users_count
         self.f = cfg.corrupted_count
-        check_defense_args(cfg.defense, self.n, self.f)
+        # Per-round cohort (config.participation): STATIC sizes — round(p·f)
+        # malicious + honest remainder — with random identities per round,
+        # so jit shapes never change and the rows-[0, m_mal) attack
+        # invariant holds.  p=1 degenerates to the reference's
+        # everyone-every-round cohort.
+        if cfg.participation < 1.0:
+            self.m = max(1, int(round(cfg.participation * self.n)))
+            self.m_mal = min(int(round(cfg.participation * self.f)), self.m)
+            if self.f > 0 and self.m_mal == 0:
+                raise ValueError(
+                    f"participation={cfg.participation} rounds the "
+                    f"malicious cohort to 0 while f={self.f} — the attack "
+                    f"would silently never run (static cohorts); raise "
+                    f"participation or set mal_prop=0 explicitly")
+            if self.m - self.m_mal > self.n - self.f:
+                raise ValueError(
+                    f"cohort needs {self.m - self.m_mal} honest clients "
+                    f"but only {self.n - self.f} exist "
+                    f"(n={self.n}, f={self.f}, "
+                    f"participation={cfg.participation})")
+        else:
+            self.m, self.m_mal = self.n, self.f
+        # The defense only ever sees the round cohort.
+        check_defense_args(cfg.defense, self.m, self.m_mal)
+        self._part_key = jax.random.key(cfg.seed ^ 0x9A47)
         if shardings is None and cfg.mesh_shape is not None:
             from attacking_federate_learning_tpu.parallel.mesh import make_plan
             shardings = make_plan(tuple(cfg.mesh_shape))
@@ -91,7 +115,9 @@ class FederatedExperiment:
             self.stream = HostStream(self.dataset.train_x,
                                      self.dataset.train_y, shards,
                                      cfg.batch_size * cfg.local_steps,
-                                     plan=shardings, n_rounds=cfg.epochs)
+                                     plan=shardings, n_rounds=cfg.epochs,
+                                     participants_fn=self._participants_host,
+                                     cohort_rows=self.m)
             if shardings is not None:
                 self.state = shardings.place_state(self.state)
         else:
@@ -167,12 +193,14 @@ class FederatedExperiment:
                        "allgather": pairwise_distances_allgather}[impl]
             mesh = self.shardings.mesh
             p = mesh.shape[CLIENTS]
-            if self.n % p != 0:
-                # shard_map's P('clients', None) in_spec needs even rows
+            if self.m % p != 0:
+                # shard_map's P('clients', None) in_spec needs even rows —
+                # the kernels see the round cohort (m), not the population
                 # (unlike the xla path, where GSPMD pads unevenly).
                 raise ValueError(
-                    f"distance_impl={impl!r} needs users_count divisible "
-                    f"by the clients mesh axis (n={self.n}, axis={p})")
+                    f"distance_impl={impl!r} needs the round cohort "
+                    f"divisible by the clients mesh axis (m={self.m}, "
+                    f"axis={p})")
 
             def with_blockwise_D(grads, n, f, _fn=fn, **extra):
                 D = dist_fn(grads.astype(jnp.float32), mesh)
@@ -240,13 +268,42 @@ class FederatedExperiment:
             xs = reflect_crop_flip(xs, round_augment_key(self.cfg.seed, t))
         return xs
 
-    def _gather_batches(self, t):
-        """Round-t minibatches for every client: one (n, k*B) gather from
-        the device-resident dataset (replaces the reference's N host-side
-        DataLoaders, user.py:52-55); k = local_steps (1 in the reference's
-        FedSGD regime)."""
+    def _participants(self, t):
+        """Round-t cohort ids, or None under full participation: the
+        first m_mal entries are malicious ids (< f), the rest honest —
+        random identities, static counts (config.participation)."""
+        if self.cfg.participation >= 1.0:
+            return None
+        k1, k2 = jax.random.split(jax.random.fold_in(self._part_key, t))
+        mal = jax.random.choice(k1, self.f, (self.m_mal,), replace=False)
+        hon = self.f + jax.random.choice(k2, self.n - self.f,
+                                         (self.m - self.m_mal,),
+                                         replace=False)
+        return jnp.concatenate([mal, hon]).astype(jnp.int32)
+
+    def _participants_host(self, t):
+        """Eager host-side cohort for the streaming prefetcher: jax's RNG
+        is platform-invariant, so running the same derivation on the CPU
+        backend yields exactly the traced path's ids without queueing a
+        tiny program behind the accelerator's in-flight round."""
+        if self.cfg.participation >= 1.0:
+            return None
+        try:
+            cpu = jax.devices("cpu")[0]
+        except RuntimeError:
+            return np.asarray(self._participants(t))
+        with jax.default_device(cpu):
+            return np.asarray(self._participants(t))
+
+    def _gather_batches(self, t, participants=None):
+        """Round-t minibatches for the round cohort: one (m, k*B) gather
+        from the device-resident dataset (replaces the reference's N
+        host-side DataLoaders, user.py:52-55); k = local_steps (1 in the
+        reference's FedSGD regime)."""
         idx = round_batch_indices(
             self.shards, t, self.cfg.batch_size * self.cfg.local_steps)
+        if participants is not None:
+            idx = idx[participants]
         return self.train_x[idx], self.train_y[idx]
 
     def _compute_grads_impl(self, state: ServerState, t, batches=None):
@@ -254,12 +311,15 @@ class FederatedExperiment:
         host-streaming mode (cfg.data_placement='host_stream') passes the
         round's pre-transferred (xs, ys) instead."""
         cfg = self.cfg
-        xs, ys = self._gather_batches(t) if batches is None else batches
+        if batches is None:
+            xs, ys = self._gather_batches(t, self._participants(t))
+        else:
+            xs, ys = batches
         xs = self._maybe_augment(xs, t)
-        # Split the flat (n, k*B) gather into k local-step minibatches.
+        # Split the flat (m, k*B) gather into k local-step minibatches.
         k, B = cfg.local_steps, cfg.batch_size
-        xs = xs.reshape((self.n, k, B) + xs.shape[2:])
-        ys = ys.reshape((self.n, k, B))
+        xs = xs.reshape((self.m, k, B) + xs.shape[2:])
+        ys = ys.reshape((self.m, k, B))
         # Clients train at the faded lr the server dispatches (reference
         # server.py:50-52; inert at k=1, user.py:80); the pseudo-gradient
         # divides by the lr the server will multiply back in so the
@@ -282,10 +342,10 @@ class FederatedExperiment:
             if self._needs_server_grad:
                 server_grad = jax.grad(make_loss_fn(self.model, self.flat))(
                     state.weights, self._meta_x, self._meta_y)
-                agg = self.defense_fn(grads, self.n, self.f,
+                agg = self.defense_fn(grads, self.m, self.m_mal,
                                       server_grad=server_grad)
             else:
-                agg = self.defense_fn(grads, self.n, self.f)
+                agg = self.defense_fn(grads, self.m, self.m_mal)
         agg = agg.astype(jnp.float32)
         if self.cfg.server_uses_faded_lr:
             lr = faded_learning_rate(self.cfg.learning_rate,
@@ -326,7 +386,7 @@ class FederatedExperiment:
             if aux and "krum_selected" in aux:
                 sel = aux["krum_selected"]
                 diag["krum_selected"] = sel
-                diag["malicious_selected"] = (sel < self.f).astype(
+                diag["malicious_selected"] = (sel < self.m_mal).astype(
                     jnp.int32)
             return diag
 
@@ -340,7 +400,8 @@ class FederatedExperiment:
         # malicious.py:11, :21).
         self._check_attack_nan = (
             getattr(self.attacker, "checks_finite", False)
-            and self.f > 0 and getattr(self.attacker, "num_std", 1) != 0)
+            and self.m_mal > 0
+            and getattr(self.attacker, "num_std", 1) != 0)
 
         # Selection telemetry: compute the Krum winner ONCE and aggregate
         # grads[sel] (krum == grads[krum_select], defenses/kernels.py) —
@@ -351,11 +412,12 @@ class FederatedExperiment:
         if getattr(self.attacker, "fusable", True):
             def fused_core(state, t, batches=None):
                 grads = self._compute_grads_impl(state, t, batches)
-                grads = self.attacker.apply(grads, self.f, ctx_for(state, t))
+                grads = self.attacker.apply(grads, self.m_mal,
+                                            ctx_for(state, t))
                 aux = {}
                 agg = None
                 if diag_select is not None:
-                    sel = diag_select(grads, self.n, self.f)
+                    sel = diag_select(grads, self.m, self.m_mal)
                     aux["krum_selected"] = sel
                     agg = grads[sel]
                 new_state = self._aggregate_impl(state, grads, t, agg=agg)
@@ -363,7 +425,7 @@ class FederatedExperiment:
 
             def crafted_nan(grads):
                 return jnp.isnan(
-                    grads[: self.f].astype(jnp.float32)).any()
+                    grads[: self.m_mal].astype(jnp.float32)).any()
 
             def fused(state, t, batches=None):
                 new_state, grads, aux = fused_core(state, t, batches)
@@ -436,7 +498,7 @@ class FederatedExperiment:
             self._raise_if_attack_nan(bad)
         else:
             grads = self._compute_grads(self.state, t, batches)
-            grads = self.attacker.apply(grads, self.f,
+            grads = self.attacker.apply(grads, self.m_mal,
                                         self._ctx_for(self.state, t))
             aux = {}
             agg = None
@@ -444,7 +506,7 @@ class FederatedExperiment:
                 # Eager selection (same knobs as the defense), aggregate
                 # the selected row directly — single distance computation,
                 # same as the fused path.
-                sel = self._krum_select_fn(grads, self.n, self.f)
+                sel = self._krum_select_fn(grads, self.m, self.m_mal)
                 aux["krum_selected"] = sel
                 agg = grads[sel]
             self.state = self._aggregate(self.state, grads, t, agg)

@@ -25,7 +25,8 @@ import numpy as np
 
 class HostStream:
     def __init__(self, train_x, train_y, shards, batch_size: int,
-                 plan=None, n_rounds=None):
+                 plan=None, n_rounds=None, participants_fn=None,
+                 cohort_rows=None):
         self.x = np.asarray(train_x)
         self.y = np.asarray(train_y)
         self.shards = np.asarray(shards)
@@ -33,14 +34,19 @@ class HostStream:
         # Prefetch horizon: no useless gather/transfer past the last round
         # (None = unbounded, for open-ended callers).
         self.n_rounds = n_rounds
+        # Optional per-round cohort: t -> index array (deterministic, so
+        # prefetching t+1 sees the same cohort the round will use).
+        self.participants_fn = participants_fn
         self._cache: dict = {}
         self._sharding_x = self._sharding_y = None
         if plan is not None:
-            # Batches shard over the clients mesh axis when it divides n
-            # (mirroring MeshPlan.place's evenness rule for other arrays).
+            # Batches shard over the clients mesh axis when it divides the
+            # per-round row count (the cohort size under participation
+            # sampling, else n) — mirroring MeshPlan.place's evenness rule.
             from jax.sharding import PartitionSpec as P
             from attacking_federate_learning_tpu.parallel.mesh import CLIENTS
-            n = self.shards.shape[0]
+            n = (cohort_rows if cohort_rows is not None
+                 else self.shards.shape[0])
             axis = CLIENTS if n % plan.mesh.shape[CLIENTS] == 0 else None
             self._sharding_x = plan.sharding(
                 P(*((axis,) + (None,) * self.x.ndim)))
@@ -51,7 +57,12 @@ class HostStream:
         shard_len = self.shards.shape[1]
         offs = (t * self.batch_size
                 + np.arange(self.batch_size)) % shard_len
-        idx = self.shards[:, offs]                      # (n, B)
+        shards = self.shards
+        if self.participants_fn is not None:
+            part = self.participants_fn(t)
+            if part is not None:
+                shards = shards[np.asarray(part)]
+        idx = shards[:, offs]                           # (m, B)
         return self.x[idx], self.y[idx]
 
     def _issue(self, t: int):
