@@ -47,7 +47,8 @@ class ExperimentConfig:
     # FedAvg-style local SGD steps per round (beyond-reference; the
     # reference is strictly FedSGD — its client optimizer never steps,
     # user.py:80).  k > 1 clients run k local steps at the faded lr and
-    # report (w0 - w_k)/lr, wire-compatible with a gradient
+    # report (w0 - w_k) divided by the lr the SERVER will multiply back
+    # in, so the FedAvg-as-FedSGD reduction is exact
     # (core/client.py:make_client_update_fn).
     local_steps: int = 1
 
@@ -64,8 +65,8 @@ class ExperimentConfig:
     # Fuse the (pure, jitted) shadow-train + clip pipeline into the round
     # program so backdoor rounds run without a per-round host hop; False
     # restores the staged path with the reference's per-round nan guard
-    # (backdoor.py:145-152) — fused mode checks the aggregated weights at
-    # span boundaries instead.
+    # (backdoor.py:145-152) — fused mode tracks an in-program isnan flag
+    # over the crafted rows, raised at the next host boundary.
     backdoor_fused: bool = True
 
     # --- defense --------------------------------------------------------

@@ -8,11 +8,12 @@ attacker.attack, collect_gradients, defend+update.  Here a round is:
     grads = attack.apply(grads, f, ctx)       # first-f-rows overwrite
     state = momentum_update(state, defense(grads, n, f))
 
-For pure attacks (none / ALIE) the whole round is one jitted function of
-``(state, round_index)`` — batch gathers included — so steady-state rounds
-are a single device program.  The backdoor attack runs its shadow-net
-optimization as its own jitted function between two jitted round halves,
-mirroring the reference's seam (main.py:66-71) without recompiling the round.
+For fusable attacks (none / ALIE / the baselines, and the backdoor by
+default — its shadow train is itself pure jitted jax) the whole round is one
+jitted function of ``(state, round_index)`` — batch gathers included — so
+steady-state rounds are a single device program; ``backdoor_fused=False``
+restores the reference's staged seam (main.py:66-71) with its per-round
+host nan guard.
 
 Evaluation, checkpointing and logging stay on the host at TEST_STEP cadence
 (reference main.py:73-95).
@@ -167,6 +168,10 @@ class FederatedExperiment:
         the distance matrix with the blockwise shard_map kernels
         (parallel/distances.py) over the clients mesh axis and hand it to
         the kernel via its ``D=`` seam."""
+        from attacking_federate_learning_tpu.defenses.kernels import (
+            krum_select
+        )
+
         cfg = self.cfg
         kw = {"method": cfg.krum_scoring_method}
         if cfg.krum_paper_scoring:
@@ -207,9 +212,6 @@ class FederatedExperiment:
                 return _fn(grads, n, f, D=D, **extra)
 
             if cfg.defense == "Krum":
-                from attacking_federate_learning_tpu.defenses.kernels import (
-                    krum_select
-                )
                 self._krum_select_fn = functools.partial(
                     with_blockwise_D, _fn=krum_select, **kw)
             return functools.partial(with_blockwise_D, **kw)
@@ -217,9 +219,6 @@ class FederatedExperiment:
         if cfg.defense == "Krum":
             # Selection telemetry shares the defense's exact knobs, so the
             # reported winner IS the aggregated client (round_diagnostics).
-            from attacking_federate_learning_tpu.defenses.kernels import (
-                krum_select
-            )
             self._krum_select_fn = functools.partial(krum_select, **kw)
         return functools.partial(fn, **kw)
 
@@ -300,10 +299,10 @@ class FederatedExperiment:
         from the device-resident dataset (replaces the reference's N
         host-side DataLoaders, user.py:52-55); k = local_steps (1 in the
         reference's FedSGD regime)."""
+        shards = (self.shards if participants is None
+                  else self.shards[participants])
         idx = round_batch_indices(
-            self.shards, t, self.cfg.batch_size * self.cfg.local_steps)
-        if participants is not None:
-            idx = idx[participants]
+            shards, t, self.cfg.batch_size * self.cfg.local_steps)
         return self.train_x[idx], self.train_y[idx]
 
     def _compute_grads_impl(self, state: ServerState, t, batches=None):

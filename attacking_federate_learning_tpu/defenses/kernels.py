@@ -70,14 +70,14 @@ def _distances_for(users_grads, impl):
 
 def _host_defense(host_fn, users_grads, users_count, corrupted_count,
                   paper_scoring):
-    """Run a defenses/host.py kernel; n/f must be static Python ints.
-
-    On a concrete (non-traced) gradient matrix this is a zero-copy
-    ``np.asarray`` view plus the host BLAS kernel — the fast path the
-    CPU-backend bench takes.  Inside a traced program it falls back to
-    ``pure_callback`` (correct, but the callback marshals the full (n, d)
-    operand — ~200 ms at n=512, d=79510 — so the engine keeps 'xla' for
-    fused round programs and 'host' for eager aggregation)."""
+    """Run a row-returning defenses/host.py kernel (Bulyan; Krum goes
+    through the scalar-index path in :func:`_host_krum_index`).  n/f must
+    be static Python ints.  On a concrete (non-traced) gradient matrix
+    this is a zero-copy ``np.asarray`` view plus the host BLAS kernel;
+    inside a traced program it falls back to ``pure_callback`` (correct,
+    but the callback marshals the full (n, d) operand — ~200 ms at n=512,
+    d=79510 — so the engine keeps 'xla' for fused round programs and
+    'host' for eager aggregation)."""
     import numpy as np
 
     n_static, f_static = int(users_count), int(corrupted_count)
