@@ -44,6 +44,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="CIFAR100 runs the WRN-40-4 the reference defines "
                         "but never exposes (reference main.py:114 excludes "
                         "it; data_sets.py:108-173 defines it)")
+    p.add_argument("--model", default=None,
+                   choices=["mnist_mlp", "mnist_cnn", "cifar10_cnn",
+                            "resnet20", "wideresnet40_4"],
+                   help="override the dataset's canonical model "
+                        "(default: MLP for MNIST, CNN for CIFAR10, "
+                        "WRN-40-4 for CIFAR100)")
     p.add_argument("-b", "--backdoor", default="No",
                    choices=["No", "pattern", "1", "2", "3"],
                    help="no backdoor, pattern trigger, or single-sample "
@@ -153,6 +159,7 @@ def config_from_args(args) -> ExperimentConfig:
         users_count=args.users_count,
         mal_prop=args.mal_prop,
         dataset=args.dataset,
+        model=args.model,
         learning_rate=args.learning_rate,
         batch_size=args.batch_size,
         epochs=args.epochs,

@@ -171,6 +171,13 @@ class ExperimentConfig:
     log_round_stats: bool = False
 
     def __post_init__(self):
+        if self.model is not None and self.model in MODEL_FAMILY:
+            want = DATASET_FAMILY.get(self.dataset)
+            if want is not None and MODEL_FAMILY[self.model] != want:
+                raise ValueError(
+                    f"model {self.model!r} expects {MODEL_FAMILY[self.model]}"
+                    f"-shaped inputs but dataset {self.dataset!r} is "
+                    f"{want}-shaped")
         if self.krum_scoring_method not in ("sort", "topk", "auto"):
             raise ValueError(
                 f"krum_scoring_method must be 'sort', 'topk' or 'auto', "
@@ -213,6 +220,16 @@ class ExperimentConfig:
                 ".csv").format(self.dataset, self.num_std, self.defense,
                                self.backdoor, self.mal_prop, self.users_count,
                                self.alpha, self.learning_rate)
+
+
+# Input-shape families for fail-fast model/dataset validation (a wrong
+# pairing otherwise surfaces as a reshape error deep inside the jit trace).
+MODEL_FAMILY = {"mnist_mlp": "mnist", "mnist_cnn": "mnist",
+                "cifar10_cnn": "cifar", "resnet20": "cifar",
+                "wideresnet40_4": "cifar"}
+DATASET_FAMILY = {MNIST: "mnist", SYNTH_MNIST: "mnist",
+                  SYNTH_MNIST_HARD: "mnist", CIFAR10: "cifar",
+                  SYNTH_CIFAR10: "cifar", CIFAR100: "cifar"}
 
 
 def default_model_for(dataset: str) -> str:

@@ -1,0 +1,53 @@
+"""Backend liveness watchdog for driver entry points.
+
+On this image a relay process brokers the TPU; when it is dead, jax
+backend initialization blocks forever in a connect-retry loop
+(CLAUDE.md).  Entry points that must always complete (bench.py, the
+benchmarks runner) call :func:`ensure_live_backend` before importing jax
+for real: a ~2 s port probe short-circuits the plainly-dead case, a
+subprocess probe catches the subtler ones, and either failure re-execs
+the process pinned to CPU.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+
+def relay_ports_listening(ports=(8082, 8083, 8087), timeout=2.0):
+    """Fast liveness check for the TPU relay's local ports."""
+    for port in ports:
+        try:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=timeout):
+                return True
+        except OSError:
+            continue
+    return False
+
+
+def _fallback_to_cpu(reason: str):
+    print(reason + "; falling back to CPU", file=sys.stderr, flush=True)
+    os.environ.update(_BENCH_BACKEND_CHECKED="1", JAX_PLATFORMS="cpu",
+                      PALLAS_AXON_POOL_IPS="")
+    os.execve(sys.executable, [sys.executable] + sys.argv, os.environ)
+
+
+def ensure_live_backend(probe_timeout=240):
+    """Guard against a dead TPU tunnel; must run before jax init."""
+    if os.environ.get("_BENCH_BACKEND_CHECKED"):
+        return
+    if (os.environ.get("PALLAS_AXON_POOL_IPS")
+            and not relay_ports_listening()):
+        _fallback_to_cpu("TPU relay ports closed")
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=probe_timeout, check=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        os.environ["_BENCH_BACKEND_CHECKED"] = "1"
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+        _fallback_to_cpu("TPU backend unreachable")

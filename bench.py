@@ -72,50 +72,6 @@ def device_krum_ms(G, f, krum_fn, jax) -> float:
     return median_ms(lambda: jax.block_until_ready(krum_fn(G, G.shape[0], f)))
 
 
-def relay_ports_listening(ports=(8082, 8083, 8087), timeout=2.0):
-    """Fast liveness check for the TPU relay's local ports."""
-    import socket
-
-    for port in ports:
-        try:
-            with socket.create_connection(("127.0.0.1", port),
-                                          timeout=timeout):
-                return True
-        except OSError:
-            continue
-    return False
-
-
-def ensure_live_backend(probe_timeout=240):
-    """Guard against a dead TPU tunnel: probe jax backend init in a
-    subprocess; on timeout re-exec on CPU so the bench always completes.
-    (On this image a relay process brokers the TPU; if it is down, jax
-    device init blocks forever.)  A 2 s port check short-circuits the
-    240 s hang when the relay is plainly dead."""
-    import os
-    import subprocess
-
-    if os.environ.get("_BENCH_BACKEND_CHECKED"):
-        return
-    if (os.environ.get("PALLAS_AXON_POOL_IPS") and
-            not relay_ports_listening()):
-        log("TPU relay ports closed; falling back to CPU")
-        os.environ.update(_BENCH_BACKEND_CHECKED="1", JAX_PLATFORMS="cpu",
-                          PALLAS_AXON_POOL_IPS="")
-        os.execve(sys.executable, [sys.executable] + sys.argv, os.environ)
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=probe_timeout, check=True,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-        os.environ["_BENCH_BACKEND_CHECKED"] = "1"
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
-        log("TPU backend unreachable; falling back to CPU")
-        os.environ.update(_BENCH_BACKEND_CHECKED="1", JAX_PLATFORMS="cpu",
-                          PALLAS_AXON_POOL_IPS="")
-        os.execve(sys.executable, [sys.executable] + sys.argv, os.environ)
-
-
 def bench_impl_table(G, f, jax, on_accel):
     """Per-impl diagnostic: every selectable distance engine at this n."""
     import functools
@@ -157,6 +113,10 @@ def mfu_line(tag, flops, ms, platform):
 
 
 def main():
+    from attacking_federate_learning_tpu.utils.backend import (
+        ensure_live_backend
+    )
+
     ensure_live_backend()
     import jax
 

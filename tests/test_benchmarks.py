@@ -1,0 +1,33 @@
+"""BASELINE-config benchmark runner smoke (benchmarks.py)."""
+
+from attacking_federate_learning_tpu import benchmarks
+
+
+def test_reference_default_cell_runs(tmp_path):
+    results = benchmarks.main(["--rounds", "2", "--cells", "1",
+                               "--scale", "0.4",
+                               "--log-dir", str(tmp_path)])
+    assert len(results) == 1
+    cell = results[0]
+    assert cell["cell"] == "ref_default"
+    assert cell["rounds_per_sec"] > 0
+    assert 0.0 <= cell["final_accuracy"] <= 100.0
+
+
+def test_unknown_cell_selection_is_empty(tmp_path):
+    assert benchmarks.main(["--cells", "9",
+                            "--log-dir", str(tmp_path)]) == []
+
+
+def test_model_dataset_family_validation():
+    import pytest
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+
+    with pytest.raises(ValueError, match="shaped"):
+        ExperimentConfig(dataset=C.MNIST, model="resnet20")
+    with pytest.raises(ValueError, match="shaped"):
+        ExperimentConfig(dataset=C.CIFAR10, model="mnist_cnn")
+    # compatible pairings construct fine
+    ExperimentConfig(dataset=C.CIFAR10, model="resnet20")
+    ExperimentConfig(dataset=C.SYNTH_MNIST, model="mnist_cnn")

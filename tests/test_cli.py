@@ -131,3 +131,9 @@ def test_attack_backdoor_requires_trigger():
     with pytest.raises(SystemExit):
         cli.build_parser()  # parser itself fine
         cli.main(["--attack", "backdoor", "-s", "SYNTH_MNIST", "-e", "1"])
+
+
+def test_model_override_flag(tmp_path):
+    _, result = run_cli(tmp_path, ["-n", "6", "-m", "0.0",
+                                   "--model", "mnist_cnn"], epochs=2)
+    assert len(result["accuracies"]) >= 1

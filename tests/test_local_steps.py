@@ -158,10 +158,13 @@ def test_cli_choices_match_registries():
     from attacking_federate_learning_tpu.attacks import ATTACKS
     from attacking_federate_learning_tpu.defenses import DEFENSES
 
+    from attacking_federate_learning_tpu.models.base import MODELS
+
     parser = cli.build_parser()
     actions = {a.dest: a for a in parser._actions}
     assert set(actions["defense"].choices) == set(DEFENSES.names())
     assert set(actions["attack"].choices) == {"auto"} | set(ATTACKS.names())
+    assert set(actions["model"].choices) == set(MODELS.names())
 
 
 def test_remat_grads_identical():

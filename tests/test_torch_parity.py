@@ -177,3 +177,46 @@ def test_cli_resume_from_reference_checkpoint(tmp_path):
                        "--run-dir", str(tmp_path / "runs"),
                        "--resume", str(path)])
     assert result["epochs"][-1] == 3  # continued from round 2
+
+
+def build_torch_mnist_cnn():
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    class Net(nn.Module):
+        # Same architecture as models/mnist_cnn.py (classic torch MNIST
+        # example shape).
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(1, 10, 5)
+            self.conv2 = nn.Conv2d(10, 20, 5)
+            self.fc1 = nn.Linear(320, 50)
+            self.fc2 = nn.Linear(50, 10)
+
+        def forward(self, x):
+            x = F.max_pool2d(F.relu(self.conv1(x)), 2)
+            x = F.max_pool2d(F.relu(self.conv2(x)), 2)
+            x = x.view(x.size(0), -1)
+            x = F.relu(self.fc1(x))
+            return F.log_softmax(self.fc2(x), dim=1)
+
+    return Net()
+
+
+def test_mnist_cnn_torch_parity():
+    model = get_model("mnist_cnn")
+    params = model.init(jax.random.key(0))
+    flat = make_flattener(params)
+    assert flat.dim == 21840
+    vec = np.asarray(flat.ravel(params))
+
+    tnet = build_torch_mnist_cnn()
+    load_flat_into_torch(vec, tnet.parameters())
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 1, 28, 28)).astype(np.float32)
+    ours = np.asarray(model.apply(flat.unravel(jnp.asarray(vec)),
+                                  jnp.asarray(x)))
+    with torch.no_grad():
+        theirs = tnet(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-5, rtol=1e-4)
