@@ -117,6 +117,10 @@ def main(argv=None):
                    type=int)
     p.add_argument("--synth-test", default=ExperimentConfig.synth_test,
                    type=int)
+    p.add_argument("--log-dir", default="logs", type=str)
+    p.add_argument("--out", default=None, type=str,
+                   help="summary JSONL path (default <log-dir>/"
+                        "grid_summary.jsonl)")
     args = p.parse_args(argv)
 
     from attacking_federate_learning_tpu.cli import apply_backend
@@ -126,10 +130,10 @@ def main(argv=None):
                             users_count=args.users_count,
                             mal_prop=args.mal_prop, epochs=args.epochs,
                             batch_size=args.batch_size, seed=args.seed,
-                            backend=args.backend,
+                            backend=args.backend, log_dir=args.log_dir,
                             synth_train=args.synth_train,
                             synth_test=args.synth_test)
-    run_grid(base, args.defenses, args.attacks)
+    run_grid(base, args.defenses, args.attacks, out_path=args.out)
 
 
 if __name__ == "__main__":
