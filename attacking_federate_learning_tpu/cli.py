@@ -267,9 +267,10 @@ def main(argv=None):
                 # checkpoint (keep_best seeding).
                 checkpointer.best_acc = float(np.load(path)["accuracy"])
         if exp.shardings is not None:
-            # Restore the planned state sharding the engine set at init.
-            _, _, _, exp.state = exp.shardings.place(
-                exp.shards, exp.train_x, exp.train_y, exp.state)
+            # Restore the planned state sharding the engine set at init
+            # (state only — data placement was already decided at init,
+            # incl. the host-streaming keep-on-host contract).
+            exp.state = exp.shardings.place_state(exp.state)
         logger.print(f"Resumed from round {int(exp.state.round)}")
     timer = PhaseTimer() if args.profile else None
     with xla_trace(args.trace_dir):

@@ -162,12 +162,13 @@ class FederatedExperiment:
     def _wire_distance_defense(self, fn):
         """Bind scoring/distance-engine knobs onto a Krum/Bulyan kernel.
 
-        'auto' resolves to the host BLAS path on a single-device CPU
-        backend (defenses/host.py — XLA:CPU gemm loses ~2x to OpenBLAS)
-        and to the XLA Gram matmul otherwise; 'ring'/'allgather' precompute
-        the distance matrix with the blockwise shard_map kernels
-        (parallel/distances.py) over the clients mesh axis and hand it to
-        the kernel via its ``D=`` seam."""
+        Inside the engine 'auto' always resolves to the XLA Gram matmul:
+        traced round programs would pay a pure_callback marshal of the
+        whole (n, d) matrix for the host path (the host BLAS engine stays
+        an explicit opt-in / eager-call path, defenses/kernels.py).
+        'ring'/'allgather' precompute the distance matrix with the
+        blockwise shard_map kernels (parallel/distances.py) over the
+        clients mesh axis and hand it to the kernel via its ``D=`` seam."""
         from attacking_federate_learning_tpu.defenses.kernels import (
             krum_select
         )
@@ -392,11 +393,15 @@ class FederatedExperiment:
         self._round_diagnostics = round_diagnostics
 
         # In-program replacement for the reference's host-side shadow-train
-        # nan guard (backdoor.py:145-152): track isnan over the crafted
-        # rows only (rows [0, f)), so a diverging *server* update can't be
-        # misattributed to the attack.  Skipped when no crafting happens
-        # (f == 0 or z == 0, mirroring the reference's early returns,
-        # malicious.py:11, :21).
+        # nan guard (backdoor.py:145-152): track non-finiteness over the
+        # crafted rows only (rows [0, f)) — matching the staged path's
+        # isfinite check, which is strictly stronger than the reference's
+        # isnan — so a diverging *server* update can't be misattributed to
+        # the attack.  Skipped when no crafting happens (f == 0 or z == 0,
+        # mirroring the reference's early returns, malicious.py:11, :21).
+        # Fused spans surface the flag at the next host boundary (the
+        # documented detection-latency trade, PARITY.md); --backdoor-staged
+        # restores the per-round raise.
         self._check_attack_nan = (
             getattr(self.attacker, "checks_finite", False)
             and self.m_mal > 0
@@ -422,15 +427,15 @@ class FederatedExperiment:
                 new_state = self._aggregate_impl(state, grads, t, agg=agg)
                 return new_state, grads, aux
 
-            def crafted_nan(grads):
-                return jnp.isnan(
-                    grads[: self.m_mal].astype(jnp.float32)).any()
+            def crafted_nonfinite(grads):
+                return (~jnp.isfinite(
+                    grads[: self.m_mal].astype(jnp.float32))).any()
 
             def fused(state, t, batches=None):
                 new_state, grads, aux = fused_core(state, t, batches)
                 diag = (round_diagnostics(grads, new_state, t, aux)
                         if cfg.log_round_stats else {})
-                bad = (crafted_nan(grads) if self._check_attack_nan
+                bad = (crafted_nonfinite(grads) if self._check_attack_nan
                        else jnp.asarray(False))
                 return new_state, diag, bad
 
@@ -444,7 +449,7 @@ class FederatedExperiment:
                     s, bad = carry
                     s2, grads, _ = fused_core(s, t0 + i)
                     if self._check_attack_nan:
-                        bad = bad | crafted_nan(grads)
+                        bad = bad | crafted_nonfinite(grads)
                     return s2, bad
 
                 return jax.lax.fori_loop(0, count, body,

@@ -335,3 +335,28 @@ def test_krum_select_host_under_jit():
     row = jax.jit(lambda g: krum(g, 9, 2, distance_impl="host"))(G)
     np.testing.assert_allclose(np.asarray(row), np.asarray(G[want]),
                                atol=0)
+
+
+def test_fused_guard_catches_inf_not_just_nan():
+    """The fused crafted-rows guard matches the staged path's isfinite
+    check: an inf (no nan) crafted gradient must abort too."""
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+    from attacking_federate_learning_tpu.attacks.base import Attack
+
+    class InfAttack(Attack):
+        checks_finite = True
+        fusable = True
+        name = "inf"
+
+        def __init__(self):
+            super().__init__(num_std=1.5)
+
+        def craft(self, mal_grads, ctx=None):
+            return jnp.full((mal_grads.shape[1],), jnp.inf)
+
+    cfg = small_cfg(epochs=1, mal_prop=0.3, defense="NoDefense")
+    exp = FederatedExperiment(cfg, attacker=InfAttack())
+    with pytest.raises(FloatingPointError, match="backdoor shadow"):
+        exp.run_round(0)
