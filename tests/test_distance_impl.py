@@ -179,3 +179,31 @@ def test_engine_blockwise_requires_divisible_cohort():
         ds = load_dataset(cfg.dataset, seed=0, synth_train=256,
                           synth_test=64)
         FederatedExperiment(cfg, dataset=ds)
+
+
+def test_engine_ring_bf16_parity():
+    """bf16 wire matrix through the ring engine matches the xla engine at
+    bf16 tolerance (distances accumulate f32 in both)."""
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.attacks import DriftAttack
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+
+    def weights(impl, mesh):
+        cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=16,
+                               mal_prop=0.2, batch_size=8, epochs=1,
+                               defense="Krum", distance_impl=impl,
+                               grad_dtype="bfloat16", mesh_shape=mesh,
+                               synth_train=512, synth_test=64)
+        ds = load_dataset(cfg.dataset, seed=0, synth_train=512,
+                          synth_test=64)
+        exp = FederatedExperiment(cfg, attacker=DriftAttack(1.0),
+                                  dataset=ds)
+        exp.run_round(0)
+        return np.asarray(exp.state.weights)
+
+    np.testing.assert_allclose(weights("ring", (8, 1)),
+                               weights("xla", None), atol=2e-5, rtol=1e-5)
