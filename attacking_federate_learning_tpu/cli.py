@@ -31,7 +31,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="how many standard deviations the attacker shifts")
     p.add_argument("-d", "--defense", default="NoDefense",
                    choices=["NoDefense", "Bulyan", "TrimmedMean", "Krum",
-                            "FLTrust", "Median", "GeoMedian", "NormBound"])
+                            "FLTrust", "Median", "GeoMedian", "NormBound",
+                            "DnC"])
     p.add_argument("--attack", default="auto",
                    choices=["auto", "none", "alie", "backdoor", "signflip",
                             "noise", "minmax", "minsum"],

@@ -339,13 +339,16 @@ class FederatedExperiment:
         computes the selection once and aggregates ``grads[sel]`` rather
         than running the O(n^2 d) distance engine twice."""
         if agg is None:
+            kw = {}
+            if getattr(self.defense_fn, "needs_round", False):
+                # Round-seeded defenses (DnC's fresh sketches) — the same
+                # attribute seam FLTrust uses for needs_server_grad.
+                kw["round"] = t
             if self._needs_server_grad:
                 server_grad = jax.grad(make_loss_fn(self.model, self.flat))(
                     state.weights, self._meta_x, self._meta_y)
-                agg = self.defense_fn(grads, self.m, self.m_mal,
-                                      server_grad=server_grad)
-            else:
-                agg = self.defense_fn(grads, self.m, self.m_mal)
+                kw["server_grad"] = server_grad
+            agg = self.defense_fn(grads, self.m, self.m_mal, **kw)
         agg = agg.astype(jnp.float32)
         if self.cfg.server_uses_faded_lr:
             lr = faded_learning_rate(self.cfg.learning_rate,

@@ -9,3 +9,4 @@ from attacking_federate_learning_tpu.defenses.geomed import (  # noqa: F401
 from attacking_federate_learning_tpu.defenses.normbound import (  # noqa: F401
     norm_bounded_mean
 )
+from attacking_federate_learning_tpu.defenses.dnc import dnc  # noqa: F401,E402

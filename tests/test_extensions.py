@@ -163,3 +163,77 @@ def test_cli_accepts_extension_choices(tmp_path):
                        "--log-dir", str(tmp_path / "logs"),
                        "--run-dir", str(tmp_path / "runs")])
     assert len(result["accuracies"]) >= 1
+
+
+# --------------------------------------------------------------------------
+# DnC (spectral filtering, NDSS'21)
+# --------------------------------------------------------------------------
+def test_dnc_filters_spectral_outliers():
+    from attacking_federate_learning_tpu.defenses.dnc import dnc
+
+    rng = np.random.default_rng(0)
+    n, d, f = 20, 4096, 4
+    G = rng.standard_normal((n, d)).astype(np.float32)
+    direction = rng.standard_normal(d).astype(np.float32)
+    # The planted collusion must clear the random-matrix noise floor of
+    # the sketch (top singular value ~ sqrt(r) ~ 45) to be spectrally
+    # identifiable — same condition the DnC paper's threat model assumes.
+    G[:f] += 100.0 * direction / np.linalg.norm(direction)
+    out = np.asarray(dnc(jnp.asarray(G), n, f))
+    honest_mean = G[f:].mean(axis=0)
+    full_mean = G.mean(axis=0)
+    # The colluding direction is the top singular direction; DnC's
+    # aggregate sits much nearer the honest mean than the poisoned mean
+    # (the residual is honest-subset jitter, not malicious mass).
+    assert (np.linalg.norm(out - honest_mean)
+            < 0.5 * np.linalg.norm(full_mean - honest_mean))
+
+
+def test_dnc_zero_f_is_exact_mean():
+    from attacking_federate_learning_tpu.defenses.dnc import dnc
+
+    rng = np.random.default_rng(1)
+    G = rng.standard_normal((16, 1024)).astype(np.float32)
+    out = np.asarray(dnc(jnp.asarray(G), 16, 0))   # remove = 0
+    np.testing.assert_allclose(out, G.mean(axis=0), atol=1e-5)
+
+
+def test_dnc_under_jit_and_engine():
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.attacks import make_attacker
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+
+    cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=12,
+                           mal_prop=0.25, batch_size=16, epochs=2,
+                           defense="DnC", synth_train=256, synth_test=64)
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=256, synth_test=64)
+    exp = FederatedExperiment(
+        cfg, attacker=make_attacker(cfg, dataset=ds, name="minmax"),
+        dataset=ds)
+    exp.run_span(0, 2)
+    assert np.all(np.isfinite(np.asarray(exp.state.weights)))
+
+
+def test_dnc_fresh_sketches_per_round_and_fallback():
+    from attacking_federate_learning_tpu.defenses.dnc import dnc
+
+    rng = np.random.default_rng(2)
+    # d > sketch_dim so rounds actually draw different coordinate subsets.
+    G = jnp.asarray(rng.standard_normal((10, 4096)).astype(np.float32))
+    a = np.asarray(dnc(G, 10, 2, round=0))
+    b = np.asarray(dnc(G, 10, 2, round=1))
+    assert not np.array_equal(a, b)          # fresh sketch per round
+    np.testing.assert_array_equal(a, np.asarray(dnc(G, 10, 2, round=0)))
+
+    # Small cohorts can empty the intersection of keep sets; the
+    # aggregate must fall back to the overall mean, never a zero update.
+    for seed in range(6):
+        H = jnp.asarray(np.random.default_rng(seed)
+                        .standard_normal((8, 4096)).astype(np.float32))
+        out = np.asarray(dnc(H, 8, 3, round=seed))
+        assert np.isfinite(out).all()
+        assert np.linalg.norm(out) > 0.01    # not the silent zero update
