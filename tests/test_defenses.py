@@ -119,15 +119,52 @@ def test_krum_paper_scoring_flag():
     np.testing.assert_allclose(paper_out, want, atol=2e-4)
 
 
-@pytest.mark.parametrize("name", ["Krum", "Bulyan"])
 @pytest.mark.parametrize("n,d,f", [(11, 30, 2), (23, 104, 5), (40, 33, 9)])
-def test_topk_and_sort_scoring_agree(name, n, d, f):
+def test_topk_and_sort_scoring_agree(n, d, f):
     """The complement-top_k evaluation (sum-of-k-smallest = rowsum minus
-    sum-of-(f-1)-largest) must match the full-sort path exactly."""
+    sum-of-(f-1)-largest) must match the full-sort path exactly.  (Krum
+    only: Bulyan's selection loop now evaluates via the presorted prefix
+    regardless of method — covered against the oracle/reference in
+    test_matches_oracle and tests/test_reference_parity.py.)"""
     G = jnp.asarray(grads_for(n, d, seed=n + d + f))
-    a = np.asarray(K.DEFENSES[name](G, n, f, method="sort"))
-    b = np.asarray(K.DEFENSES[name](G, n, f, method="topk"))
+    a = np.asarray(K.krum(G, n, f, method="sort"))
+    b = np.asarray(K.krum(G, n, f, method="topk"))
     np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+@pytest.mark.parametrize("n,d,f", [(11, 30, 2), (23, 104, 5), (40, 33, 9)])
+@pytest.mark.parametrize("paper", [False, True])
+def test_bulyan_presorted_prefix_matches_per_iteration_scoring(n, d, f,
+                                                               paper):
+    """Bulyan's presort-once selection must reproduce the per-iteration
+    _krum_scores loop exactly (same winners in the same order), ties and
+    paper-scoring included."""
+    import jax
+    from jax import lax
+
+    G = jnp.asarray(grads_for(n, d, seed=n * 3 + d + f))
+    G = G.at[2].set(G[5])  # exact duplicate rows -> tied scores
+    D = K.pairwise_distances(G)
+    set_size = n - 2 * f
+
+    def old_selection(D):
+        def body(t, carry):
+            alive, selected = carry
+            scores = K._krum_scores(D, n - t, f, alive=alive,
+                                    paper_scoring=paper)
+            idx = jnp.argmin(scores)
+            return alive.at[idx].set(False), selected.at[t].set(idx)
+
+        _, selected = lax.fori_loop(
+            0, set_size, body,
+            (jnp.ones((n,), bool), jnp.zeros((set_size,), jnp.int32)))
+        return selected
+
+    want = np.asarray(old_selection(D))
+    got = np.asarray(K.bulyan(G, n, f, paper_scoring=paper))
+    ref = np.asarray(K.trimmed_mean_of(G[jnp.asarray(want)],
+                                       set_size - 2 * f - 1))
+    np.testing.assert_allclose(got, ref, atol=1e-6)
 
 
 def test_bf16_grads_accepted():
