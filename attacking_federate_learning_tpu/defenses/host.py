@@ -52,17 +52,18 @@ def host_pairwise_distances(G: np.ndarray) -> np.ndarray:
     return D
 
 
-def _scores(D, pool, f, alive, paper_scoring=False):
-    """Sum of the k smallest distances to alive peers per row; +inf for
-    dead rows.  k = pool - f, or pool - f - 2 under paper scoring
-    (SURVEY.md §2.4 #4).  (Top-level Krum doesn't come through here — it
-    partitions squared distances directly, host_krum below.)"""
-    n = D.shape[0]
+def _prefix_scores(sortedD, order, finite, alive, pool, f,
+                   paper_scoring=False):
+    """Sum of the k smallest alive distances per row, evaluated as an
+    alive-masked rank prefix over presorted rows (same presort-once
+    scheme as the XLA Bulyan, defenses/kernels.py); +inf for dead rows.
+    k = pool - f, or pool - f - 2 under paper scoring (SURVEY.md §2.4
+    #4)."""
     k = pool - f - (2 if paper_scoring else 0)
-    Dm = np.where(alive[None, :], D, np.inf)
-    k = max(min(k, n - 1), 0)
-    srt = np.sort(Dm, axis=1)[:, :k]
-    scores = np.where(np.isfinite(srt), srt, 0.0).sum(axis=1)
+    alive_cols = alive[order]
+    rank = np.cumsum(alive_cols, axis=1)
+    take = alive_cols & (rank <= k) & finite
+    scores = np.where(take, sortedD, 0.0).sum(axis=1)
     scores[~alive] = np.inf
     return scores
 
@@ -112,11 +113,15 @@ def host_bulyan(G, users_count, corrupted_count, paper_scoring=False):
     f = corrupted_count
     set_size = users_count - 2 * f
     D = host_pairwise_distances(G)
+    order = np.argsort(D, axis=1, kind="stable")
+    sortedD = np.take_along_axis(D, order, axis=1)
+    finite = np.isfinite(sortedD)
     alive = np.ones(n, bool)
     selected = []
     for t in range(set_size):
-        scores = _scores(D, users_count - t, f, alive=alive,
-                         paper_scoring=paper_scoring)
+        scores = _prefix_scores(sortedD, order, finite, alive,
+                                users_count - t, f,
+                                paper_scoring=paper_scoring)
         idx = int(np.argmin(scores))
         selected.append(idx)
         alive[idx] = False
