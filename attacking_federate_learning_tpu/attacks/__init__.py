@@ -36,9 +36,11 @@ from attacking_federate_learning_tpu.attacks.minmax import (  # noqa: E402
 )
 
 ATTACKS.register("minmax",
-                 lambda cfg, dataset=None: MinMaxAttack(cfg.num_std))
+                 lambda cfg, dataset=None: MinMaxAttack(
+                     cfg.num_std, direction=cfg.attack_direction))
 ATTACKS.register("minsum",
-                 lambda cfg, dataset=None: MinSumAttack(cfg.num_std))
+                 lambda cfg, dataset=None: MinSumAttack(
+                     cfg.num_std, direction=cfg.attack_direction))
 
 
 def make_attacker(cfg, dataset=None, name=None):

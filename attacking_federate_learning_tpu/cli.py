@@ -39,6 +39,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="'auto' = reference behavior (backdoor if -b set, "
                         "else ALIE, reference main.py:44-54); the rest are "
                         "beyond-reference baselines (attacks/)")
+    p.add_argument("--attack-direction", default="std",
+                   choices=["std", "sign", "unit"],
+                   help="min-max/min-sum perturbation direction "
+                        "(attacks/minmax.py): cohort -std (the NDSS'21 "
+                        "paper's best), -sign(mean), or -unit mean")
+    p.add_argument("--dnc-iters", default=ExperimentConfig.dnc_iters,
+                   type=int, help="DnC filtering iterations")
+    p.add_argument("--dnc-sketch-dim",
+                   default=ExperimentConfig.dnc_sketch_dim, type=int,
+                   help="DnC coordinate-sketch size per iteration")
+    p.add_argument("--dnc-filter-frac",
+                   default=ExperimentConfig.dnc_filter_frac, type=float,
+                   help="DnC outliers removed per iteration, as a "
+                        "fraction of f")
     p.add_argument("-s", "--dataset", default=C.MNIST,
                    choices=[C.MNIST, C.CIFAR10, C.CIFAR100, C.SYNTH_MNIST,
                             C.SYNTH_CIFAR10, C.SYNTH_MNIST_HARD],
@@ -110,8 +124,15 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["sort", "topk", "auto"],
                    help="Krum/Bulyan score evaluation: cancellation-free "
                         "'sort' (default), complement-'topk' (cheaper at "
-                        "large n / small f; a subtraction — check tolerance "
-                        "for your threat model), or 'auto' to pick by shape")
+                        "large n / small f; a runtime guard falls back to "
+                        "sort when the subtraction would cancel), or "
+                        "'auto' to pick by shape")
+    p.add_argument("--bulyan-batch-select", default=1, type=int,
+                   help="Bulyan selection batch size: q>1 selects the q "
+                        "lowest-scoring clients per trip against the same "
+                        "scores (a flagged relaxation of the reference's "
+                        "sequential selection for the 10k regime); 1 = "
+                        "reference-exact")
     p.add_argument("--distance-impl", default="auto",
                    choices=["auto", "xla", "pallas", "host", "ring",
                             "allgather"],
@@ -183,12 +204,17 @@ def config_from_args(args) -> ExperimentConfig:
         krum_paper_scoring=args.krum_paper_scoring,
         krum_scoring_method=args.krum_scoring_method,
         distance_impl=args.distance_impl,
+        bulyan_batch_select=args.bulyan_batch_select,
         server_uses_faded_lr=args.server_uses_faded_lr,
         log_round_stats=args.round_stats,
         synth_train=args.synth_train,
         synth_test=args.synth_test,
         data_augment={"auto": None, "on": True, "off": False}[args.augment],
         backdoor_fused=not args.backdoor_staged,
+        attack_direction=args.attack_direction,
+        dnc_iters=args.dnc_iters,
+        dnc_sketch_dim=args.dnc_sketch_dim,
+        dnc_filter_frac=args.dnc_filter_frac,
     )
 
 

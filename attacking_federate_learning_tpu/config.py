@@ -139,11 +139,12 @@ class ExperimentConfig:
     krum_paper_scoring: bool = False
     # Score evaluation strategy: 'sort' (default — oracle-verified and
     # cancellation-free under arbitrary attacker magnitudes), 'topk'
-    # (complement subtraction — cheaper at large n / small f, but a
-    # subtraction, so opt in after checking tolerance for your threat
-    # model), or 'auto' (pick by shape).  The round-1 CPU bench regression
-    # attributed to 'sort' was actually the XLA:CPU gemm — see
-    # distance_impl below — so the numerically safest method stays default.
+    # (complement subtraction — cheaper at large n / small f; carries a
+    # runtime cancellation guard that re-evaluates via the sort path
+    # whenever the subtraction would lose precision, so it is safe under
+    # adversarial magnitudes too — kernels.py:_krum_scores), or 'auto'
+    # (pick by shape).  The round-1 CPU bench regression attributed to
+    # 'sort' was actually the XLA:CPU gemm — see distance_impl below.
     krum_scoring_method: str = "sort"
     # Distance engine for Krum/Bulyan (defenses/kernels.py):
     #   'auto'      xla inside the engine's traced round programs (a host
@@ -157,8 +158,29 @@ class ExperimentConfig:
     #   'allgather' one all_gather + per-device tiles
     # (ring/allgather require a device mesh, parallel/distances.py).
     distance_impl: str = "auto"
+    # Bulyan selection batching (defenses/kernels.py:bulyan): q>1 is an
+    # explicit, flagged relaxation of the reference's strictly sequential
+    # selection for the large-n regime — each trip selects the q
+    # lowest-scoring clients against the same scores, re-scoring between
+    # trips (ceil(set_size/q) trips instead of set_size).  1 = the
+    # reference's exact semantics (the default, like every quirk flag).
+    bulyan_batch_select: int = 1
     # Attack statistics over the malicious cohort only (reference
     # malicious.py:14-19), matching the ALIE threat model.
+
+    # --- beyond-reference attack/defense knobs --------------------------
+    # Perturbation direction for the min-max/min-sum attacks
+    # (attacks/minmax.py): cohort negative std ('std', the NDSS'21 paper's
+    # best performer), -sign(mean) ('sign'), or negative unit mean ('unit').
+    attack_direction: str = "std"
+    # DnC spectral defense constants (defenses/dnc.py) — the most
+    # constant-sensitive defense, so its knobs live in the config like
+    # every other quirk flag.  Sketch keys derive from (seed, round, iter),
+    # so repeat runs with different seeds draw different coordinate
+    # subsets (the paper's random-subsampling assumption).
+    dnc_iters: int = 5
+    dnc_sketch_dim: int = 2048
+    dnc_filter_frac: float = 1.5
 
     # --- metadata subsystem (reference C12, vestigial there) ------------
     collect_metadata: bool = False
@@ -191,6 +213,21 @@ class ExperimentConfig:
             raise ValueError(
                 f"data_placement must be 'device' or 'host_stream', "
                 f"got {self.data_placement!r}")
+        if self.bulyan_batch_select < 1:
+            raise ValueError(
+                f"bulyan_batch_select must be >= 1, got "
+                f"{self.bulyan_batch_select}")
+        if self.attack_direction not in ("std", "sign", "unit"):
+            raise ValueError(
+                f"attack_direction must be 'std', 'sign' or 'unit', "
+                f"got {self.attack_direction!r}")
+        if self.dnc_iters < 1 or self.dnc_sketch_dim < 1:
+            raise ValueError(
+                f"dnc_iters/dnc_sketch_dim must be >= 1, got "
+                f"{self.dnc_iters}/{self.dnc_sketch_dim}")
+        if self.dnc_filter_frac <= 0:
+            raise ValueError(
+                f"dnc_filter_frac must be > 0, got {self.dnc_filter_frac}")
         if self.local_steps < 1:
             raise ValueError(
                 f"local_steps must be >= 1, got {self.local_steps}")

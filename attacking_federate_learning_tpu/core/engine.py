@@ -94,6 +94,16 @@ class FederatedExperiment:
         self.defense_fn = DEFENSES[cfg.defense]
         if cfg.defense in ("Krum", "Bulyan"):
             self.defense_fn = self._wire_distance_defense(self.defense_fn)
+        elif cfg.defense == "DnC":
+            # DnC's constants are config surface (the most constant-
+            # sensitive defense), and its sketch keys flow from the
+            # experiment seed so repeat runs with different seeds draw
+            # different coordinate subsets (defenses/dnc.py).
+            self.defense_fn = functools.partial(
+                self.defense_fn, n_iters=cfg.dnc_iters,
+                sketch_dim=cfg.dnc_sketch_dim,
+                filter_frac=cfg.dnc_filter_frac, seed=cfg.seed)
+            self.defense_fn.needs_round = True  # partial drops attributes
 
         key = jax.random.key(cfg.seed)
         k_init, self.key_run = jax.random.split(key)
@@ -177,6 +187,10 @@ class FederatedExperiment:
         kw = {"method": cfg.krum_scoring_method}
         if cfg.krum_paper_scoring:
             kw["paper_scoring"] = True
+        bulyan_kw = ({"batch_select": cfg.bulyan_batch_select}
+                     if (cfg.defense == "Bulyan"
+                         and cfg.bulyan_batch_select != 1) else {})
+        kw.update(bulyan_kw)
         impl = cfg.distance_impl
         if impl == "auto":
             # Inside the fused round program 'host' would pay the
@@ -468,8 +482,12 @@ class FederatedExperiment:
 
     # ------------------------------------------------------------------
     def _raise_if_attack_nan(self, bad):
-        """Host side of the crafted-rows nan flag (exact reference
-        message, backdoor.py:146)."""
+        """Host side of the crafted-rows nan flag — reference-equivalent
+        guard, not message parity: the reference raises
+        ``Exception('Got nan dist loss')`` / ``Exception('Got nan loss')``
+        (backdoor.py:145-152); this raises FloatingPointError with one
+        message for both, and checks isfinite (strictly stronger than the
+        reference's isnan)."""
         if self._check_attack_nan and bool(bad):
             raise FloatingPointError("Got nan in backdoor shadow training")
 
@@ -487,10 +505,23 @@ class FederatedExperiment:
                 self.run_round(t)
         else:
             self.last_round_stats = None
+            pre_span = None
+            if self._check_attack_nan:
+                # The span donates self.state, so when the in-program nan
+                # flag fires the post-nan state is all a caller would have
+                # left — unlike the staged/reference path, where the raise
+                # leaves the last good round behind.  A host snapshot of
+                # the pre-span state (~2 vectors of d) keeps catch-and-
+                # continue callers (benchmarks.py) recoverable.
+                pre_span = jax.tree.map(np.asarray, self.state)
             self.state, bad = self._fused_span(
                 self.state, jnp.asarray(start, jnp.int32),
                 jnp.asarray(count, jnp.int32))
-            self._raise_if_attack_nan(bad)
+            if self._check_attack_nan and bool(bad):
+                self.state = (self.shardings.place_state(pre_span)
+                              if self.shardings is not None
+                              else jax.tree.map(jnp.asarray, pre_span))
+                self._raise_if_attack_nan(bad)
         return self.state
 
     def run_round(self, t: int) -> ServerState:

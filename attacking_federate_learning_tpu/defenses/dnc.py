@@ -34,11 +34,17 @@ _SKETCH_DIM = 2048
 _POWER_STEPS = 10
 
 
-def _top_direction(Sc):
+def _top_direction(Sc, key):
     """Dominant right singular vector of the centered sketch via power
-    iteration on Sc^T Sc (r-dim; never materializes the r x r Gram)."""
+    iteration on Sc^T Sc (r-dim; never materializes the r x r Gram).
+
+    The iterate starts from a key-derived random vector, not a constant:
+    a fixed init lets a defense-aware adversary craft gradients whose
+    dominant direction is orthogonal to it, stalling convergence toward
+    a lesser direction; a random init has measure-zero overlap failure."""
     r = Sc.shape[1]
-    v = jnp.full((r,), 1.0 / jnp.sqrt(r), Sc.dtype)
+    v = jax.random.normal(key, (r,), Sc.dtype)
+    v = v / jnp.maximum(jnp.linalg.norm(v), 1e-12)
     for _ in range(_POWER_STEPS):
         v = Sc.T @ (Sc @ v)
         v = v / jnp.maximum(jnp.linalg.norm(v), 1e-12)
@@ -59,22 +65,23 @@ def dnc(users_grads, users_count, corrupted_count, n_iters: int = _N_ITERS,
     keep = n - remove
     r = min(sketch_dim, d)
     if r == d:
-        # Full-coverage sketch: scores are column-permutation-invariant,
-        # so every iteration would produce the identical keep set.
+        # Full-coverage sketch: every iteration sees the same matrix, and
+        # power iteration converges to the same dominant direction from
+        # any (random) init — one iteration suffices.
         n_iters = 1
     base_key = jax.random.fold_in(jax.random.key(seed ^ 0xD0C),
                                   jnp.asarray(round, jnp.int32))
 
     good = jnp.ones((n,), bool)
     for i in range(n_iters):
+        k_idx, k_pow = jax.random.split(jax.random.fold_in(base_key, i))
         if r == d:
             S = G
         else:
-            idx = jax.random.choice(jax.random.fold_in(base_key, i), d,
-                                    (r,), replace=False)
+            idx = jax.random.choice(k_idx, d, (r,), replace=False)
             S = G[:, idx]
         Sc = S - jnp.mean(S, axis=0)[None, :]
-        v = _top_direction(Sc)
+        v = _top_direction(Sc, k_pow)
         scores = (Sc @ v) ** 2
         # Clients whose score ranks within the keep smallest survive
         # this iteration.

@@ -105,25 +105,34 @@ def host_trimmed_mean_of(sel: np.ndarray, number_to_consider: int):
     return (kept.mean(axis=0) + med).astype(np.float32)
 
 
-def host_bulyan(G, users_count, corrupted_count, paper_scoring=False):
+def host_bulyan(G, users_count, corrupted_count, paper_scoring=False,
+                batch_select=1):
     """Bulyan (reference defences.py:55-70): iterative Krum selection with
-    a shrinking pool, then trimmed mean with parameter 2f."""
+    a shrinking pool, then trimmed mean with parameter 2f.
+
+    ``batch_select=q`` mirrors the XLA kernel's flagged relaxation
+    (defenses/kernels.py:bulyan): each trip takes the q lowest-scoring
+    alive clients against the same scores (stable argsort — ties to the
+    lowest index, matching both first-occurrence ``np.argmin`` and
+    ``lax.top_k``), re-scoring between trips.  q=1 is reference-exact."""
     G = np.asarray(G, np.float32)
     n = G.shape[0]
     f = corrupted_count
     set_size = users_count - 2 * f
+    q = min(max(int(batch_select), 1), set_size)
     D = host_pairwise_distances(G)
     order = np.argsort(D, axis=1, kind="stable")
     sortedD = np.take_along_axis(D, order, axis=1)
     finite = np.isfinite(sortedD)
     alive = np.ones(n, bool)
     selected = []
-    for t in range(set_size):
+    while len(selected) < set_size:
+        r = min(q, set_size - len(selected))
         scores = _prefix_scores(sortedD, order, finite, alive,
-                                users_count - t, f,
+                                users_count - len(selected), f,
                                 paper_scoring=paper_scoring)
-        idx = int(np.argmin(scores))
-        selected.append(idx)
-        alive[idx] = False
+        idxs = np.argsort(scores, kind="stable")[:r]
+        selected.extend(int(i) for i in idxs)
+        alive[idxs] = False
     sel = G[selected]
     return host_trimmed_mean_of(sel, set_size - 2 * f - 1)

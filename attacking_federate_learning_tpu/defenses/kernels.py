@@ -28,8 +28,11 @@ is 2f (defences.py:70).  Ties resolve to the lowest index, matching
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from attacking_federate_learning_tpu.ops.distances import pairwise_distances
@@ -39,6 +42,11 @@ from attacking_federate_learning_tpu.utils.registry import Registry
 DEFENSES = Registry("defense")
 
 _INF = jnp.inf
+# topk cancellation guard: required ratio of a row's kept score mass to
+# the complement subtraction's noise floor (eps * log2(n) * rowsum).
+# 1e4 keeps the relative score error under ~1e-4 whenever topk is used;
+# below that the evaluation falls back to the exact sort path.
+_TOPK_GUARD = 1e4
 
 
 def resolve_distance_impl(distance_impl, users_count=None, users_grads=None):
@@ -118,9 +126,18 @@ def _krum_scores(D, users_count, corrupted_count, alive=None,
     - 'auto': 'topk' when the complement is small relative to n.
 
     Default is 'sort' — the oracle-verified path.  'topk' is numerically a
-    subtraction and can lose precision when adversarial gradients make the
-    rowsum huge; opt in (or use 'auto') for the large-n/small-f regime
-    after checking tolerance for your threat model.
+    subtraction, so it carries a runtime cancellation guard: with
+    kept = rowsum - sum-of-complement, the subtraction's absolute error is
+    ~eps * log2(n) * rowsum, so whenever any row's kept mass falls below
+    ``_TOPK_GUARD * eps * log2(n) * rowsum`` (relative score error no
+    longer <= 1/_TOPK_GUARD-ish) the evaluation falls back to the
+    cancellation-free sort path via ``lax.cond`` — one branch executes at
+    runtime, so the benign large-n/small-f regime keeps topk's cost while
+    adversarial magnitudes (reference malicious.py-scale rows, which
+    concentrate the rowsum in the complement) get sort's exactness
+    automatically.  Inf/nan rowsums fail the guard explicitly
+    (``isfinite(rowsum)`` is part of the reliability predicate), so
+    overflow also lands on 'sort'.
     """
     n = D.shape[0]
     # entries per row = pool - 1, k = pool - f (- 2 paper) -> complement is
@@ -128,6 +145,16 @@ def _krum_scores(D, users_count, corrupted_count, alive=None,
     complement = corrupted_count - 1 + (2 if paper_scoring else 0)
     if method == "auto":
         method = "topk" if (0 <= complement <= max(n // 4, 1)) else "sort"
+
+    def sort_scores():
+        Dm = D + jnp.diag(jnp.full((n,), _INF, D.dtype))
+        if alive is not None:
+            row_dead = jnp.where(alive, 0.0, _INF)
+            Dm = Dm + row_dead[None, :] + row_dead[:, None]
+        k = users_count - corrupted_count - (2 if paper_scoring else 0)
+        srt = jnp.sort(Dm, axis=1)  # ascending; masked entries land last
+        prefix = (jnp.arange(n) < k) & jnp.isfinite(srt)
+        return jnp.sum(jnp.where(prefix, srt, 0.0), axis=1)
 
     if method == "topk" and complement >= 0:
         pair_alive = None
@@ -138,17 +165,23 @@ def _krum_scores(D, users_count, corrupted_count, alive=None,
         rowsum = jnp.sum(jnp.where(mask, D, 0.0), axis=1)
         if complement > 0:
             top, _ = lax.top_k(jnp.where(mask, D, -_INF), complement)
-            rowsum = rowsum - jnp.sum(jnp.maximum(top, 0.0), axis=1)
-        scores = rowsum
+            kept = rowsum - jnp.sum(jnp.maximum(top, 0.0), axis=1)
+            # Cancellation guard (see docstring): every row's kept mass
+            # must clear the subtraction's noise floor, else re-evaluate
+            # via the sort path.  Rows whose guard comparison is nan
+            # (inf - inf) count as failing.
+            eps = jnp.finfo(D.dtype).eps
+            floor = (_TOPK_GUARD * eps * max(np.log2(max(n, 2)), 1.0)
+                     * rowsum)
+            # isfinite(rowsum): an overflowed rowsum gives kept = floor =
+            # inf and inf >= inf would pass — overflow must fail the
+            # guard, not just nan.
+            reliable = jnp.all((kept >= floor) & jnp.isfinite(rowsum))
+            scores = lax.cond(reliable, lambda: kept, sort_scores)
+        else:
+            scores = rowsum
     else:
-        Dm = D + jnp.diag(jnp.full((n,), _INF, D.dtype))
-        if alive is not None:
-            row_dead = jnp.where(alive, 0.0, _INF)
-            Dm = Dm + row_dead[None, :] + row_dead[:, None]
-        k = users_count - corrupted_count - (2 if paper_scoring else 0)
-        srt = jnp.sort(Dm, axis=1)  # ascending; masked entries land last
-        prefix = (jnp.arange(n) < k) & jnp.isfinite(srt)
-        scores = jnp.sum(jnp.where(prefix, srt, 0.0), axis=1)
+        scores = sort_scores()
     if alive is not None:
         scores = jnp.where(alive, scores, _INF)
     return scores
@@ -240,7 +273,7 @@ def trimmed_mean(users_grads, users_count, corrupted_count):
 
 @DEFENSES.register("Bulyan")
 def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
-           method="sort", distance_impl="xla", D=None):
+           method="sort", distance_impl="xla", D=None, batch_select=1):
     """Bulyan (reference defences.py:55-70): iteratively Krum-select
     n - 2f gradients (removing each winner from the pool, with the pool
     size — but not f — shrinking), then trim-mean the selection with
@@ -253,10 +286,25 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
     the same multiset whatever the tie order).  ``method`` therefore only
     affects top-level :func:`krum`; ``paper_scoring`` still selects the
     k = pool - f - 2 variant.  ``distance_impl`` / ``D``: same contract
-    as :func:`krum`."""
+    as :func:`krum`.
+
+    ``batch_select=q`` is an explicit, flagged relaxation for the
+    large-n regime (the 10k north star), where the reference's strictly
+    sequential selection is O(n) iterations of O(n^2) scoring by its
+    nature (BASELINE.md): each trip selects the q lowest-scoring alive
+    clients against the SAME scores, re-scoring only between trips, so
+    the loop runs ceil(set_size/q) trips instead of set_size.  q=1 IS
+    the reference semantics (ties resolve to the lowest index either
+    way: ``lax.top_k`` breaks ties toward lower indices, matching
+    first-occurrence ``np.argmin``) — the default, and what every
+    oracle/reference-parity test pins."""
     n, _ = users_grads.shape
     f = corrupted_count
     set_size = users_count - 2 * f
+    q = int(batch_select)
+    if not (1 <= q):
+        raise ValueError(f"batch_select must be >= 1, got {batch_select}")
+    q = min(q, set_size)
     if D is None:
         impl = resolve_distance_impl(distance_impl, users_count,
                                      users_grads)
@@ -264,7 +312,10 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
             from attacking_federate_learning_tpu.defenses.host import (
                 host_bulyan
             )
-            return _host_defense(host_bulyan, users_grads, users_count,
+            host_fn = host_bulyan
+            if q > 1:
+                host_fn = functools.partial(host_bulyan, batch_select=q)
+            return _host_defense(host_fn, users_grads, users_count,
                                  corrupted_count, paper_scoring)
         D = _distances_for(users_grads, impl)
 
@@ -274,21 +325,31 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
     order = jnp.argsort(Dm, axis=1)
     sortedD = jnp.take_along_axis(Dm, order, axis=1)
     finite = jnp.isfinite(sortedD)
+    trips = -(-set_size // q)
 
     def body(t, carry):
         alive, selected = carry
-        k = users_count - t - f - (2 if paper_scoring else 0)
+        # Pool at trip start: everyone minus the t*q already selected.
+        k = users_count - t * q - f - (2 if paper_scoring else 0)
         alive_cols = alive[order]                       # (n, n) gather
         rank = jnp.cumsum(alive_cols, axis=1)           # 1-based among alive
         take = alive_cols & (rank <= k) & finite
         scores = jnp.sum(jnp.where(take, sortedD, 0.0), axis=1)
         scores = jnp.where(alive, scores, _INF)
-        idx = jnp.argmin(scores)
-        return alive.at[idx].set(False), selected.at[t].set(idx)
+        # q lowest scores, ascending (ties -> lower index, like argmin);
+        # only the first r count on the (possibly short) final trip.
+        _, idxs = lax.top_k(-scores, q)
+        r = jnp.minimum(q, set_size - t * q)
+        live = jnp.arange(q) < r
+        kill = jnp.zeros((n,), bool).at[idxs].set(live)
+        selected = lax.dynamic_update_slice(
+            selected, jnp.where(live, idxs, 0).astype(jnp.int32), (t * q,))
+        return alive & ~kill, selected
 
     alive0 = jnp.ones((n,), bool)
-    sel0 = jnp.zeros((set_size,), jnp.int32)
-    _, selected = lax.fori_loop(0, set_size, body, (alive0, sel0))
+    sel0 = jnp.zeros((trips * q,), jnp.int32)
+    _, selected = lax.fori_loop(0, trips, body, (alive0, sel0))
+    selected = selected[:set_size]
 
     selection = users_grads[selected]  # (set_size, d), in selection order
     number_to_consider = set_size - 2 * f - 1

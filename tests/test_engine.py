@@ -256,6 +256,39 @@ def test_fused_backdoor_nan_guard_fires():
         exp.run_span(2, 2)
 
 
+def test_fused_span_nan_leaves_recoverable_state():
+    """When the fused span's nan guard fires, the engine restores the
+    pre-span state before raising (the span donates its input, so without
+    the snapshot the post-nan state would be all that's left — unlike the
+    staged/reference path whose per-round raise leaves the last good
+    round).  Catch-and-continue callers (benchmarks.py) rely on this."""
+    import numpy as np
+    import pytest
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.attacks import make_attacker
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+
+    cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=8,
+                           mal_prop=0.25, batch_size=16, epochs=4,
+                           defense="NoDefense", backdoor="pattern",
+                           mal_learning_rate=1e30,  # shadow train overflows
+                           synth_train=512, synth_test=64)
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=512, synth_test=64)
+    exp = FederatedExperiment(cfg, attacker=make_attacker(cfg, dataset=ds),
+                              dataset=ds)
+    pre = np.asarray(exp.state.weights).copy()
+    pre_round = int(exp.state.round)
+    with pytest.raises(FloatingPointError, match="backdoor shadow"):
+        exp.run_span(0, 4)
+    np.testing.assert_array_equal(np.asarray(exp.state.weights), pre)
+    assert int(exp.state.round) == pre_round
+    assert np.isfinite(np.asarray(exp.state.weights)).all()
+
+
 def test_round_stats_report_krum_selection():
     """Under Krum with --round-stats, the diagnostics carry the selected
     client index and a malicious-selected flag (reference

