@@ -127,7 +127,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "large n / small f; a runtime guard falls back to "
                         "sort when the subtraction would cancel), or "
                         "'auto' to pick by shape")
-    p.add_argument("--bulyan-batch-select", default=1, type=int,
+    p.add_argument("--bulyan-batch-select",
+                   default=ExperimentConfig.bulyan_batch_select, type=int,
                    help="Bulyan selection batch size: q>1 selects the q "
                         "lowest-scoring clients per trip against the same "
                         "scores (a flagged relaxation of the reference's "

@@ -172,13 +172,16 @@ class FederatedExperiment:
     def _wire_distance_defense(self, fn):
         """Bind scoring/distance-engine knobs onto a Krum/Bulyan kernel.
 
-        Inside the engine 'auto' always resolves to the XLA Gram matmul:
-        traced round programs would pay a pure_callback marshal of the
-        whole (n, d) matrix for the host path (the host BLAS engine stays
-        an explicit opt-in / eager-call path, defenses/kernels.py).
-        'ring'/'allgather' precompute the distance matrix with the
-        blockwise shard_map kernels (parallel/distances.py) over the
-        clients mesh axis and hand it to the kernel via its ``D=`` seam."""
+        'auto' stays UNRESOLVED in the wired partial: the kernels resolve
+        it per call (defenses/kernels.py:resolve_distance_impl) — 'xla'
+        for traced operands (a host round-trip inside the fused round
+        program would pay a pure_callback marshal of the whole (n, d)
+        matrix every round), and host BLAS for eager CPU-backend calls,
+        which is exactly what the staged path's eager aggregation feeds
+        it (_build_round_fns).  'ring'/'allgather' precompute the
+        distance matrix with the blockwise shard_map kernels
+        (parallel/distances.py) over the clients mesh axis and hand it
+        to the kernel via its ``D=`` seam."""
         from attacking_federate_learning_tpu.defenses.kernels import (
             krum_select
         )
@@ -192,13 +195,6 @@ class FederatedExperiment:
                          and cfg.bulyan_batch_select != 1) else {})
         kw.update(bulyan_kw)
         impl = cfg.distance_impl
-        if impl == "auto":
-            # Inside the fused round program 'host' would pay the
-            # pure_callback marshal of the whole (n, d) matrix every round
-            # (defenses/kernels.py:_host_defense), so traced rounds stay on
-            # 'xla' on every backend; 'host' remains an explicit opt-in for
-            # eager/CPU aggregation (the bench's CPU-fallback path).
-            impl = "xla"
         if impl in ("ring", "allgather"):
             if self.shardings is None:
                 raise ValueError(
@@ -477,7 +473,22 @@ class FederatedExperiment:
             self._staged = False
         else:
             self._compute_grads = jax.jit(self._compute_grads_impl)
-            self._aggregate = jax.jit(self._aggregate_impl, donate_argnums=0)
+            # Staged rounds already cross the host boundary every round,
+            # so on the CPU backend a Krum/Bulyan aggregation runs EAGERLY:
+            # the kernel then sees concrete arrays and 'auto' resolves to
+            # the host BLAS engine zero-copy (defenses/host.py) instead of
+            # paying XLA:CPU's ~2x gemm penalty inside jit (measured in
+            # BASELINE.md).  Everything else keeps the jitted aggregate.
+            # (Not under a device mesh: the jitted aggregate preserves the
+            # MeshPlan state placement; the eager path would silently
+            # un-place state and gather the sharded matrix every round.)
+            eager_host_agg = (jax.default_backend() == "cpu"
+                              and self.shardings is None
+                              and cfg.defense in ("Krum", "Bulyan")
+                              and cfg.distance_impl in ("auto", "host"))
+            self._aggregate = (self._aggregate_impl if eager_host_agg
+                               else jax.jit(self._aggregate_impl,
+                                            donate_argnums=0))
             self._staged = True
 
     # ------------------------------------------------------------------
