@@ -127,6 +127,12 @@ def main(argv=None):
                         "3's ResNet shadow-train compile is impractical "
                         "on one CPU core; 5 = the 10k grid north star)")
     p.add_argument("--log-dir", type=str, default="logs")
+    p.add_argument("--strict", dest="strict", action="store_true",
+                   default=True,
+                   help="exit nonzero if any requested cell failed "
+                        "(default: on — an unattended end-of-round sweep "
+                        "must distinguish 'failed' from 'not requested')")
+    p.add_argument("--no-strict", dest="strict", action="store_false")
     args = p.parse_args(argv)
 
     on_accel = jax.devices()[0].platform not in ("cpu",)
@@ -147,6 +153,16 @@ def main(argv=None):
             cell = {"cell": name, "failed": f"{type(e).__name__}: {e}"}
         results.append(cell)
         print(json.dumps(cell), flush=True)
+    failed = [c["cell"] for c in results if "failed" in c]
+    if args.strict and failed:
+        # Loud failure for unattended sweeps: a failed cell must not look
+        # like an unrequested one.  The full result list (successful
+        # cells included) rides on the exception for programmatic
+        # callers that catch SystemExit.
+        err = SystemExit(
+            f"benchmarks: {len(failed)} cell(s) failed: {', '.join(failed)}")
+        err.results = results
+        raise err
     return results
 
 

@@ -117,6 +117,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "'host_stream' keeps it in host RAM and "
                         "double-buffers per-round batches (beyond-HBM "
                         "datasets)")
+    p.add_argument("--stream-prefetch",
+                   default=ExperimentConfig.stream_prefetch, type=int,
+                   help="host_stream pipeline depth: rounds of batches "
+                        "kept in flight (data/stream.py)")
+    p.add_argument("--stream-workers",
+                   default=ExperimentConfig.stream_workers, type=int,
+                   choices=[0, 1],
+                   help="1 = run the host gather + transfer on a "
+                        "background thread so it overlaps device compute")
     p.add_argument("--no-checkpoint", action="store_true",
                    help="disable the acc>70%% checkpoint (reference "
                         "main.py:84-89 behavior is on by default)")
@@ -201,6 +210,8 @@ def config_from_args(args) -> ExperimentConfig:
         backend=args.backend,
         mesh_shape=mesh_shape,
         data_placement=args.data_placement,
+        stream_prefetch=args.stream_prefetch,
+        stream_workers=args.stream_workers,
         remat=args.remat,
         krum_paper_scoring=args.krum_paper_scoring,
         krum_scoring_method=args.krum_scoring_method,

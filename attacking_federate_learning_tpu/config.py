@@ -119,6 +119,13 @@ class ExperimentConfig:
     # FEMNIST-scale mode, SURVEY.md §7.3 #5).  Streaming feeds one round
     # per device program, so eval-to-eval span fusion is off in that mode.
     data_placement: str = "device"
+    # host_stream pipeline tuning (data/stream.py): how many rounds of
+    # batches stay in flight, and whether gather+transfer run on a
+    # background thread (workers=1) so the host gather overlaps device
+    # compute instead of sitting on the round path.  Defaults reproduce
+    # the single-slot async-put double buffer.
+    stream_prefetch: int = 1
+    stream_workers: int = 0
     mesh_shape: Optional[tuple] = None  # (clients_devices, model_devices);
                                         # None -> all devices on client axis
     grad_dtype: str = "float32"      # dtype of the (n, d) gradient matrix;
@@ -213,6 +220,10 @@ class ExperimentConfig:
             raise ValueError(
                 f"data_placement must be 'device' or 'host_stream', "
                 f"got {self.data_placement!r}")
+        if self.stream_prefetch < 1 or self.stream_workers not in (0, 1):
+            raise ValueError(
+                f"stream_prefetch must be >= 1 and stream_workers 0 or 1, "
+                f"got {self.stream_prefetch}/{self.stream_workers}")
         if self.bulyan_batch_select < 1:
             raise ValueError(
                 f"bulyan_batch_select must be >= 1, got "

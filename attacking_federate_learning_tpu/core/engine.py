@@ -128,7 +128,9 @@ class FederatedExperiment:
                                      cfg.batch_size * cfg.local_steps,
                                      plan=shardings, n_rounds=cfg.epochs,
                                      participants_fn=self._participants_host,
-                                     cohort_rows=self.m)
+                                     cohort_rows=self.m,
+                                     prefetch=cfg.stream_prefetch,
+                                     workers=cfg.stream_workers)
             if shardings is not None:
                 self.state = shardings.place_state(self.state)
         else:

@@ -4,17 +4,24 @@ The default engine path device-puts the whole training set once and gathers
 every round's (n_clients, B) batch on device — ideal while the dataset fits
 HBM (MNIST/CIFAR do).  For FEMNIST-scale corpora (SURVEY.md §7.3 #5) the
 training arrays must stay in host RAM; this feeder gathers each round's
-batch on the host and overlaps the host->device transfer of round t+1 with
-round t's compute:
+batch on the host and overlaps the host->device transfer of upcoming
+rounds with the current round's compute:
 
     xs, ys = stream.get(t)     # returns round t (already on device),
-                               # then issues the async device_put for t+1
+                               # then issues prefetches for t+1..t+depth
 
-``jax.device_put`` is asynchronous on accelerator backends, so the prefetch
-is one round deep with no threads — the same single-slot double buffering a
-tf.data/grain input pipeline would do, minus the dependency.  Round-batch
-semantics are identical to the device path (data/partition.py
-round_batch_indices: cycling wrap-around, static shapes).
+``jax.device_put`` is asynchronous on accelerator backends, so with the
+default ``workers=0`` the prefetch costs no threads — the same single-slot
+double buffering a tf.data/grain input pipeline would do, minus the
+dependency.  When the HOST GATHER itself binds (the (m, k·B) fancy-index
+over a 10k-client shard table is real CPU work that ``workers=0`` performs
+synchronously on the round path), ``workers=1`` moves gather+put onto one
+background thread so they overlap device compute; ``prefetch`` deepens the
+pipeline so a slow round can't starve the next.  Round-batch semantics are
+identical to the device path either way (data/partition.py
+round_batch_indices: cycling wrap-around, static shapes; the per-round
+cohort derivation is deterministic, so prefetched rounds see exactly the
+cohort the round will use).
 """
 
 from __future__ import annotations
@@ -26,7 +33,7 @@ import numpy as np
 class HostStream:
     def __init__(self, train_x, train_y, shards, batch_size: int,
                  plan=None, n_rounds=None, participants_fn=None,
-                 cohort_rows=None):
+                 cohort_rows=None, prefetch: int = 1, workers: int = 0):
         self.x = np.asarray(train_x)
         self.y = np.asarray(train_y)
         self.shards = np.asarray(shards)
@@ -37,6 +44,14 @@ class HostStream:
         # Optional per-round cohort: t -> index array (deterministic, so
         # prefetching t+1 sees the same cohort the round will use).
         self.participants_fn = participants_fn
+        self.prefetch = max(int(prefetch), 1)
+        self._pool = None
+        if workers:
+            # One worker keeps issue order = round order (a deeper pool
+            # would reorder gathers without helping: they contend on the
+            # same host memory bandwidth).
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(max_workers=1)
         self._cache: dict = {}
         self._sharding_x = self._sharding_y = None
         if plan is not None:
@@ -65,22 +80,38 @@ class HostStream:
         idx = shards[:, offs]                           # (m, B)
         return self.x[idx], self.y[idx]
 
+    def _produce(self, t: int):
+        xs, ys = self._host_gather(t)
+        return (jax.device_put(xs, self._sharding_x),
+                jax.device_put(ys, self._sharding_y))
+
     def _issue(self, t: int):
         if t in self._cache:
             return
-        xs, ys = self._host_gather(t)
-        self._cache[t] = (jax.device_put(xs, self._sharding_x),
-                          jax.device_put(ys, self._sharding_y))
+        self._cache[t] = (self._pool.submit(self._produce, t)
+                          if self._pool is not None else self._produce(t))
 
     def get(self, t: int):
-        """Device batch for round t; prefetches round t+1 (within the
-        horizon)."""
+        """Device batch for round t; prefetches rounds t+1..t+prefetch
+        (within the horizon)."""
         t = int(t)
         self._issue(t)                    # hit if prefetched, else sync
         out = self._cache.pop(t)
-        # Drop stale slots (e.g. after a resume jump), keep memory at one
-        # in-flight round.
-        self._cache = {k: v for k, v in self._cache.items() if k == t + 1}
-        if self.n_rounds is None or t + 1 < self.n_rounds:
-            self._issue(t + 1)            # async: overlaps round t compute
+        # Drop stale slots (e.g. after a resume jump), keep memory at
+        # `prefetch` in-flight rounds.  Dropped futures are cancelled:
+        # a queued-but-unstarted stale gather would otherwise delay the
+        # next round's (it shares the single worker), and a failed one
+        # would swallow its exception.
+        stale = [v for k, v in self._cache.items()
+                 if not (t < k <= t + self.prefetch)]
+        self._cache = {k: v for k, v in self._cache.items()
+                       if t < k <= t + self.prefetch}
+        if self._pool is not None:
+            for fut in stale:
+                fut.cancel()
+        for u in range(t + 1, t + 1 + self.prefetch):
+            if self.n_rounds is None or u < self.n_rounds:
+                self._issue(u)            # async: overlaps round t compute
+        if self._pool is not None:
+            out = out.result()
         return out

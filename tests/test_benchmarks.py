@@ -31,3 +31,21 @@ def test_model_dataset_family_validation():
     # compatible pairings construct fine
     ExperimentConfig(dataset=C.CIFAR10, model="resnet20")
     ExperimentConfig(dataset=C.SYNTH_MNIST, model="mnist_cnn")
+
+
+def test_strict_exits_nonzero_on_failed_cell(tmp_path, monkeypatch):
+    """VERDICT r2 #10: --strict (default) must distinguish 'cell failed'
+    from 'cell not requested' with a nonzero exit."""
+    import pytest
+
+    def boom(*a, **k):
+        raise RuntimeError("injected cell failure")
+
+    monkeypatch.setattr(benchmarks, "run_cell", boom)
+    with pytest.raises(SystemExit, match="ref_default"):
+        benchmarks.main(["--rounds", "1", "--cells", "1",
+                         "--log-dir", str(tmp_path)])
+    # --no-strict keeps the record-and-continue behavior.
+    results = benchmarks.main(["--rounds", "1", "--cells", "1",
+                               "--no-strict", "--log-dir", str(tmp_path)])
+    assert results[0]["failed"].startswith("RuntimeError")
