@@ -13,15 +13,36 @@ over the reference itself) measured on this host's CPU.
 Output: {"metric": "krum_agg_<n>c_wall_ms", "value": <ms>,
          "unit": "ms", "vs_baseline": <cpu_ms / our_ms>}
 
-Diagnostics (per-impl table, MFU estimates, a 10k-client TPU-only probe
-toward the BASELINE.md north star, FL round throughput) go to stderr.
+Diagnostics (per-impl table incl. the Mosaic-compiled pallas kernel, MFU,
+the 10k-client north-star suite from BASELINE.md, FL round throughput) go
+to stderr, with a recap block at the very end so the driver's tail capture
+records the accelerator numbers.
+
+Timing methodology (this box): the TPU is brokered by a relay, and
+``jax.block_until_ready`` does NOT reliably wait for remote completion
+through it (observed: a 667-GFLOP Gram matmul "finishing" in 0.09 ms).
+Every timed section therefore dispatches K back-to-back executions and
+then fetches one element of the LAST output to host — the single device
+stream executes in dispatch order, so the fetch bounds all K — and
+subtracts a separately-measured fetch round-trip.
+
+Hang protection is layered, because no single mechanism covers a relay
+that dies mid-run (the round-2 failure mode): (a) each phase runs under
+a SIGALRM bound — interrupts Python-level waits; (b) relay liveness is
+re-probed (1 s port check) before every accelerator phase — catches a
+death between phases without burning an alarm; (c) a daemon-thread
+final deadline force-exits the process after flushing the recap and the
+best-effort JSON line — covers a fetch blocked inside native code,
+where a Python signal handler can never run.
 """
 
 from __future__ import annotations
 
 import json
+import signal
 import sys
 import time
+from contextlib import contextmanager
 
 import numpy as np
 
@@ -30,14 +51,82 @@ N_CLIENTS = 2048
 DIM = 79_510          # MNIST MLP wire dim (reference data_sets.py:13-23)
 F_FRAC = 0.24         # reference default mal proportion (main.py:106)
 REPEATS = 5
+N_NORTH = 10_240      # BASELINE.md north star
+HOST_FLOOR_10K_MS = 72_700.0  # measured host-BLAS floor @ 10,240 (BASELINE.md)
 
 # Peak f32-accumulation matmul throughput used for the MFU estimate.
 # TPU v5e: 197 TFLOP/s bf16, ~98 TFLOP/s f32 (public spec sheet numbers).
 PEAK_FLOPS = {"tpu": 98e12, "axon": 98e12}
 
+RECAP: list[str] = []
+RESULT: dict = {}   # headline snapshot for the final-deadline escape hatch
+
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
+
+
+def recap(msg):
+    log(msg)
+    RECAP.append(msg)
+
+
+def emit_result_json():
+    if RESULT:
+        print(json.dumps(RESULT), flush=True)
+
+
+def arm_final_deadline(seconds):
+    """Daemon timer: if the whole bench overruns (a fetch wedged inside
+    native code — SIGALRM can't interrupt that — or simply too slow a
+    link), flush the recap and the best-effort JSON line, then force-exit
+    so the driver gets a clean record instead of an external kill with
+    empty stdout.  The bound must exceed the sum of all per-phase alarms
+    (~4080 s on accel) so a slow-but-progressing run is never cut."""
+    import os
+    import threading
+
+    def fire():
+        log(f"=== OVERALL DEADLINE ({seconds}s) hit "
+            "(native hang or link too slow); "
+            "force-exiting with banked results ===")
+        for line in RECAP:
+            log(line)
+        emit_result_json()
+        sys.stderr.flush()
+        sys.stdout.flush()
+        os._exit(0 if RESULT else 2)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+@contextmanager
+def phase(name, seconds):
+    """Run a bench phase under a wall-clock bound; skip (never hang) on
+    timeout or error — a relay death mid-run must not kill the bench."""
+    def handler(signum, frame):
+        raise TimeoutError(f"exceeded {seconds}s")
+    old = signal.signal(signal.SIGALRM, handler)
+    signal.alarm(seconds)
+    t0 = time.perf_counter()
+    try:
+        yield
+    except Exception as e:
+        recap(f"[{name}] SKIPPED after {time.perf_counter() - t0:.0f}s: "
+              f"{type(e).__name__}: {e}")
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def relay_alive():
+    from attacking_federate_learning_tpu.utils.backend import (
+        relay_ports_listening
+    )
+    return relay_ports_listening(timeout=1.0)
 
 
 def median_ms(fn, repeats=REPEATS):
@@ -66,50 +155,110 @@ def numpy_krum_ms(G: np.ndarray, f: int) -> float:
     return median_ms(run)
 
 
-def device_krum_ms(G, f, krum_fn, jax) -> float:
-    out = krum_fn(G, G.shape[0], f)       # compile + warm
-    jax.block_until_ready(out)
-    return median_ms(lambda: jax.block_until_ready(krum_fn(G, G.shape[0], f)))
+def fetch1(out) -> float:
+    """Host-fetch one element of (the first leaf of) ``out`` — the only
+    sync primitive that provably waits for remote completion here.
+    Slices a 1-element corner (never ravel: that would materialize a
+    full copy of a multi-GB array before the fetch)."""
+    import jax
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    tiny = leaf[(slice(0, 1),) * leaf.ndim]
+    return float(np.asarray(tiny).ravel()[0])
 
 
-def bench_impl_table(G, f, jax, on_accel):
-    """Per-impl diagnostic: every selectable distance engine at this n."""
-    import functools
-
-    from attacking_federate_learning_tpu.defenses.kernels import krum
-
-    n = G.shape[0]
-    rows = {}
-    impls = ["xla"]
-    if not on_accel:
-        impls.append("host")
-    else:
-        impls.append("pallas")
-    for impl in impls:
-        try:
-            if impl == "host":
-                # Eager host-BLAS dispatch — zero-copy view, no callback.
-                fn = functools.partial(krum, distance_impl="host")
-                krum_fn = fn
-            else:
-                krum_fn = jax.jit(
-                    functools.partial(krum, distance_impl=impl),
-                    static_argnums=(1, 2))
-            ms = device_krum_ms(G, f, krum_fn, jax)
-            rows[impl] = ms
-            log(f"  krum impl={impl:9s} n={n}: {ms:8.2f} ms")
-        except Exception as e:
-            log(f"  krum impl={impl:9s} n={n}: failed "
-                f"({type(e).__name__}: {e})")
-    return rows
+def fetch_rtt_ms(x, reps=5) -> float:
+    """Cost of dispatching a trivial op on a 1-element corner of ``x``
+    + fetching it: exactly the per-loop overhead the timed loops pay on
+    their final fetch (no full-array copy — see fetch1).  Fresh value
+    each rep so jax's host-copy cache can't lie."""
+    ts = []
+    corner = x[(slice(0, 1),) * x.ndim]
+    for i in range(reps):
+        y = corner + np.float32(i)
+        t0 = time.perf_counter()
+        float(np.asarray(y).ravel()[0])
+        ts.append(1e3 * (time.perf_counter() - t0))
+    return float(np.median(ts))
 
 
-def mfu_line(tag, flops, ms, platform):
+def timed_ms(make_out, iters=6, loops=3, rtt=0.0):
+    """Median over ``loops`` of: dispatch ``iters`` back-to-back
+    executions, fetch one element of the last output (in-order device
+    stream => bounds all of them), minus fetch RTT, per iteration.
+    Returns ``(ms, last_fetched_value)`` so callers that need an output
+    element (e.g. a selection index) don't pay an extra execution.
+    Clamped at 0.05 ms: on a jittery link the one-shot RTT estimate can
+    exceed a fast kernel's wall time, and a <=0 result would poison the
+    vs_baseline division downstream."""
+    val = fetch1(make_out())        # compile + warm
+    ts = []
+    for _ in range(loops):
+        t0 = time.perf_counter()
+        for _ in range(iters - 1):
+            make_out()
+        out = make_out()
+        val = fetch1(out)
+        wall = 1e3 * (time.perf_counter() - t0)
+        if rtt > 0.5 * wall:
+            log(f"  (rtt correction {rtt:.1f} ms dominates wall "
+                f"{wall:.1f} ms — timing unreliable at this size)")
+        ts.append(max((wall - rtt) / iters, 0.05))
+    return float(np.median(ts)), val
+
+
+def device_krum_ms(G, f, krum_fn, iters=6, rtt=0.0) -> float:
+    ms, _ = timed_ms(lambda: krum_fn(G, G.shape[0], f), iters=iters,
+                     rtt=rtt)
+    return ms
+
+
+def mfu_line(tag, flops, ms, platform, to_recap=False):
     peak = PEAK_FLOPS.get(platform)
     if peak and ms > 0:
         achieved = flops / (ms * 1e-3)
-        log(f"  mfu[{tag}]: {achieved / 1e12:.1f} TFLOP/s = "
-            f"{100 * achieved / peak:.1f}% of f32 peak")
+        line = (f"  mfu[{tag}]: {achieved / 1e12:.1f} TFLOP/s = "
+                f"{100 * achieved / peak:.1f}% of f32 peak")
+        (recap if to_recap else log)(line)
+
+
+def bench_impl_table(G, f, on_accel, rtt=0.0, iters=4):
+    """Per-impl diagnostic: every selectable distance engine at this n,
+    with cross-impl Krum selection-index agreement (the on-chip pallas
+    parity check VERDICT round-2 item #2 asks for)."""
+    import functools
+
+    import jax
+
+    from attacking_federate_learning_tpu.defenses.kernels import krum_select
+
+    n = G.shape[0]
+    rows = {}
+    idxs = {}
+    impls = ["xla", "pallas"] if on_accel else ["xla", "host"]
+    for impl in impls:
+        try:
+            if impl == "host":
+                sel_fn = functools.partial(krum_select, distance_impl="host")
+            else:
+                sel_fn = jax.jit(
+                    functools.partial(krum_select, distance_impl=impl),
+                    static_argnums=(1, 2))
+            # krum_select returns the index itself, so the timed loop's
+            # final fetch already holds it — no extra execution.
+            ms, val = timed_ms(lambda: sel_fn(G, n, f), iters=iters,
+                               rtt=rtt)
+            idx = int(val)
+            rows[impl] = ms
+            idxs[impl] = idx
+            recap(f"  krum impl={impl:9s} n={n}: {ms:10.2f} ms  (select={idx})")
+        except Exception as e:
+            recap(f"  krum impl={impl:9s} n={n}: failed "
+                  f"({type(e).__name__}: {e})")
+    if len(set(idxs.values())) > 1:
+        recap(f"  !! impl DISAGREEMENT at n={n}: {idxs}")
+    elif len(idxs) > 1:
+        recap(f"  impls agree at n={n} (select={next(iter(idxs.values()))})")
+    return rows
 
 
 def main():
@@ -118,50 +267,136 @@ def main():
     )
 
     ensure_live_backend()
-    import jax
+    import functools
 
+    import jax
     import jax.numpy as jnp
 
-    from attacking_federate_learning_tpu.defenses.kernels import krum
+    from attacking_federate_learning_tpu.defenses.kernels import (
+        bulyan, krum, trimmed_mean
+    )
 
     dev = jax.devices()[0]
     on_accel = dev.platform not in ("cpu",)
+    arm_final_deadline(5100 if on_accel else 1800)
     n = N_CLIENTS if on_accel else 512  # keep the CPU fallback tractable
     f = int(F_FRAC * n)
-    log(f"device: {dev.platform} ({dev.device_kind}); n={n} d={DIM} f={f}")
+    recap(f"device: {dev.platform} ({dev.device_kind}); n={n} d={DIM} f={f}")
 
     rng = np.random.default_rng(0)
-    G_host = rng.standard_normal((n, DIM)).astype(np.float32)
 
     # --- baseline: NumPy/BLAS on host CPU ------------------------------
+    # The kernels are data-oblivious (matmul + sort), so the baseline's
+    # data need not be bit-identical to the device run's.
+    G_host = rng.standard_normal((n, DIM)).astype(np.float32)
     cpu_ms = numpy_krum_ms(G_host, f)
-    log(f"numpy/BLAS krum: {cpu_ms:.1f} ms (median of {REPEATS})")
+    recap(f"numpy/BLAS krum: {cpu_ms:.1f} ms (median of {REPEATS})")
 
     # --- ours: the framework's dispatching kernel ----------------------
-    # On an accelerator: the jitted XLA Gram-matmul path on the chip.
+    # On an accelerator: the jitted XLA Gram-matmul path on the chip,
+    # with data GENERATED ON DEVICE (no multi-GB relay transfer).
     # On the CPU fallback: distance_impl='auto' resolves to the host-BLAS
     # engine (defenses/host.py) — backend-aware dispatch is the product
     # behavior, not a bench trick.
-    import functools
-
-    G = jax.device_put(jnp.asarray(G_host), dev)
     if on_accel:
+        key = jax.random.PRNGKey(0)
+        G = jax.jit(
+            lambda k: jax.random.normal(k, (n, DIM), jnp.float32))(key)
+        fetch1(G)
+        rtt = fetch_rtt_ms(G)
+        log(f"fetch rtt: {rtt:.2f} ms")
         krum_fn = jax.jit(krum, static_argnums=(1, 2))
     else:
+        G = jnp.asarray(G_host)
+        rtt = 0.0
         # Eager: distance_impl='auto' resolves to the host-BLAS engine.
         krum_fn = functools.partial(krum, distance_impl="auto")
-    dev_ms = device_krum_ms(G, f, krum_fn, jax)
-    impl = "xla/jit" if on_accel else "host-blas (auto)"
-    log(f"framework krum [{impl}] ({dev.platform}): {dev_ms:.2f} ms "
-        f"(median of {REPEATS})")
-    # Gram matmul dominates: 2 n^2 d FLOPs.
-    mfu_line("krum_gram", 2 * n * n * DIM, dev_ms, dev.platform)
 
-    log("per-impl table:")
-    bench_impl_table(G, f, jax, on_accel)
+    dev_ms = None
+    with phase("headline", 420):
+        dev_ms = device_krum_ms(G, f, krum_fn, rtt=rtt)
+        impl = "xla/jit" if on_accel else "host-blas (auto)"
+        recap(f"framework krum [{impl}] ({dev.platform}): {dev_ms:.2f} ms")
+        RESULT.update(
+            metric=f"krum_agg_{n}c_wall_ms", value=round(dev_ms, 3),
+            unit="ms", vs_baseline=round(cpu_ms / dev_ms, 2))
+        # Gram matmul dominates: 2 n^2 d FLOPs.
+        mfu_line("krum_gram", 2 * n * n * DIM, dev_ms, dev.platform,
+                 to_recap=True)
+
+    if dev_ms is None:
+        # Accelerator died under us before the headline — restart the
+        # whole bench pinned to CPU so the driver still gets a number.
+        if on_accel:
+            from attacking_federate_learning_tpu.utils.backend import (
+                _fallback_to_cpu
+            )
+            _fallback_to_cpu("accelerator failed mid-bench")
+        raise SystemExit("CPU headline failed")
+
+    with phase("impl-table", 420):
+        log("per-impl table:")
+        bench_impl_table(G, f, on_accel, rtt=rtt)
+
+    # --- north star: 10k clients (BASELINE.md), accel only --------------
+    def gate():
+        if not relay_alive():
+            raise RuntimeError("relay gone")
+
+    G10 = None
+    f10 = int(F_FRAC * N_NORTH)
+    if on_accel and relay_alive():
+        with phase("north-star-data", 300):
+            G10 = jax.jit(lambda k: jax.random.normal(
+                k, (N_NORTH, DIM), jnp.float32))(jax.random.PRNGKey(1))
+            fetch1(G10)
+        with phase("north-star-krum", 600):
+            if G10 is None:
+                raise RuntimeError("G10 unavailable (creation failed)")
+            ms10 = device_krum_ms(
+                G10, f10, jax.jit(krum, static_argnums=(1, 2)),
+                iters=3, rtt=rtt)
+            recap(f"north-star: krum @ {N_NORTH} clients, d={DIM}: "
+                  f"{ms10:.1f} ms (host-BLAS floor {HOST_FLOOR_10K_MS:.0f} ms"
+                  f" => {HOST_FLOOR_10K_MS / ms10:.0f}x)")
+            mfu_line("krum_gram_10k", 2 * N_NORTH * N_NORTH * DIM, ms10,
+                     dev.platform, to_recap=True)
+            log("per-impl table @ 10k:")
+            bench_impl_table(G10, f10, on_accel, rtt=rtt, iters=2)
+        with phase("north-star-trimmed-mean", 420):
+            gate()
+            if G10 is None:
+                raise RuntimeError("G10 unavailable (creation failed)")
+            tm_fn = jax.jit(trimmed_mean, static_argnums=(1, 2))
+            ms_tm, _ = timed_ms(lambda: tm_fn(G10, N_NORTH, f10),
+                                iters=2, rtt=rtt)
+            recap(f"north-star: trimmed_mean @ {N_NORTH}: {ms_tm:.1f} ms")
+        with phase("north-star-bulyan-batched", 420):
+            gate()
+            if G10 is None:
+                raise RuntimeError("G10 unavailable (creation failed)")
+            bq_fn = jax.jit(
+                functools.partial(bulyan, batch_select=64),
+                static_argnums=(1, 2))
+            ms_bq, _ = timed_ms(lambda: bq_fn(G10, N_NORTH, f10),
+                                iters=1, loops=2, rtt=rtt)
+            recap(f"north-star: bulyan[q=64] @ {N_NORTH}: {ms_bq:.1f} ms")
+        with phase("north-star-bulyan-exact", 600):
+            gate()
+            if G10 is None:
+                raise RuntimeError("G10 unavailable (creation failed)")
+            b1_fn = jax.jit(bulyan, static_argnums=(1, 2))
+            ms_b1, _ = timed_ms(lambda: b1_fn(G10, N_NORTH, f10),
+                                iters=1, loops=1, rtt=rtt)
+            recap(f"north-star: bulyan[q=1 exact] @ {N_NORTH}: {ms_b1:.1f} ms")
+        del G10
+    elif on_accel:
+        recap("north-star suite SKIPPED: relay died before it could run")
 
     # --- secondary: full FL round throughput (stderr diagnostic) --------
-    try:
+    with phase("fl-throughput", 600):
+        if on_accel and not relay_alive():
+            raise RuntimeError("relay gone")
         from attacking_federate_learning_tpu.attacks import DriftAttack
         from attacking_federate_learning_tpu.config import ExperimentConfig
         from attacking_federate_learning_tpu.core.engine import (
@@ -179,24 +414,29 @@ def main():
                                       dataset=ds)
             reps = 20
             exp.run_span(0, reps)  # compile the scanned span
-            jax.block_until_ready(exp.state.weights)
+            fetch1(exp.state.weights)
             t0 = time.perf_counter()
             exp.run_span(reps, reps)  # one device program for all rounds
-            jax.block_until_ready(exp.state.weights)
+            fetch1(exp.state.weights)
             dt = time.perf_counter() - t0
             rps = reps / dt
-            log(f"fl_rounds_per_sec (Krum+ALIE, {n_clients} clients, "
-                f"mnist-mlp, scanned span): {rps:.1f}")
+            recap(f"fl_rounds_per_sec (Krum+ALIE, {n_clients} clients, "
+                  f"mnist-mlp, scanned span): {rps:.1f}")
             # vmapped fwd/bwd of the MLP: ~6 * n * B * d FLOPs per round.
             mfu_line(f"fl_round_{n_clients}c",
                      reps * 6 * n_clients * cfg.batch_size * DIM, 1e3 * dt,
                      dev.platform)
-    except Exception as e:
-        log(f"round-throughput probe skipped: {type(e).__name__}: {e}")
 
     # --- backdoor rounds/sec: fused vs staged (stderr diagnostic) -------
-    try:
+    with phase("backdoor", 600):
+        if on_accel and not relay_alive():
+            raise RuntimeError("relay gone")
         from attacking_federate_learning_tpu.attacks import make_attacker
+        from attacking_federate_learning_tpu.config import ExperimentConfig
+        from attacking_federate_learning_tpu.core.engine import (
+            FederatedExperiment
+        )
+        from attacking_federate_learning_tpu.data.datasets import load_dataset
 
         def backdoor_rps(fused, n_clients=32, reps=10):
             cfg = ExperimentConfig(
@@ -208,42 +448,22 @@ def main():
             exp = FederatedExperiment(
                 cfg, attacker=make_attacker(cfg, dataset=ds), dataset=ds)
             exp.run_span(0, reps)
-            jax.block_until_ready(exp.state.weights)
+            fetch1(exp.state.weights)
             t0 = time.perf_counter()
             exp.run_span(reps, reps)
-            jax.block_until_ready(exp.state.weights)
+            fetch1(exp.state.weights)
             return reps / (time.perf_counter() - t0)
 
-        log(f"backdoor_rounds_per_sec fused={backdoor_rps(True):.2f} "
-            f"staged={backdoor_rps(False):.2f} "
-            f"(32 clients, pattern trigger, TrimmedMean)")
-    except Exception as e:
-        log(f"backdoor probe skipped: {type(e).__name__}: {e}")
+        recap(f"backdoor_rounds_per_sec fused={backdoor_rps(True):.2f} "
+              f"staged={backdoor_rps(False):.2f} "
+              f"(32 clients, pattern trigger, TrimmedMean)")
 
-    # --- north-star probe: 10k clients, TPU only (stderr) ---------------
-    try:
-        if not on_accel:
-            raise RuntimeError("accelerator not available")
-        n10k = 10_240
-        f10k = int(F_FRAC * n10k)
-        krum_jit = jax.jit(krum, static_argnums=(1, 2))
-        G10 = jax.device_put(
-            jnp.asarray(rng.standard_normal((n10k, DIM)).astype(np.float32)))
-        ms10 = device_krum_ms(G10, f10k, krum_jit, jax)
-        log(f"north-star: krum @ {n10k} clients, d={DIM}: {ms10:.1f} ms")
-        mfu_line("krum_gram_10k", 2 * n10k * n10k * DIM, ms10, dev.platform)
-        log("per-impl table @ 10k:")
-        bench_impl_table(G10, f10k, jax, on_accel)
-        del G10
-    except Exception as e:  # OOM on small hosts is fine — diagnostic only
-        log(f"10k-client probe skipped: {type(e).__name__}: {e}")
+    # Recap block last so the driver's stderr tail records the story.
+    log("=== bench recap ===")
+    for line in RECAP:
+        log(line)
 
-    print(json.dumps({
-        "metric": f"krum_agg_{n}c_wall_ms",
-        "value": round(dev_ms, 3),
-        "unit": "ms",
-        "vs_baseline": round(cpu_ms / dev_ms, 2),
-    }))
+    emit_result_json()
 
 
 if __name__ == "__main__":
