@@ -9,12 +9,19 @@ import os
 # runs — so env vars alone cannot change the already-frozen platform
 # selection for this process; they still matter for subprocesses and for
 # the lazily-read flags below.
-os.environ["PALLAS_AXON_POOL_IPS"] = ""          # keep child processes off
-os.environ["JAX_PLATFORMS"] = "cpu"              # the TPU relay
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# FL_TEST_TPU=1: run the suite on the real TPU backend instead of the
+# 8-virtual-CPU-device harness (the VERDICT round-2 "first chip session"
+# re-run: fused-backdoor bit-identity, Mosaic pallas, engine suites on
+# real XLA:TPU).  Multi-device tests skip themselves via their own
+# device-count guards.
+TPU_MODE = os.environ.get("FL_TEST_TPU") == "1"
+if not TPU_MODE:
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""      # keep child processes off
+    os.environ["JAX_PLATFORMS"] = "cpu"          # the TPU relay
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 # Persistent compile cache: the suite compiles dozens of kernel variants and
 # this box has one core — caching cuts re-runs from minutes to seconds.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
@@ -29,7 +36,8 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 # is unreachable, which otherwise blocks forever in a connect-retry loop).
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not TPU_MODE:
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

