@@ -110,10 +110,11 @@ def run_cell(name, overrides, attack, rounds, scale, log_dir):
 
 def main(argv=None):
     from attacking_federate_learning_tpu.utils.backend import (
-        ensure_live_backend
+        enable_compile_cache, ensure_live_backend
     )
 
     ensure_live_backend()
+    enable_compile_cache()
     import jax
 
     p = argparse.ArgumentParser(description=__doc__)

@@ -259,6 +259,12 @@ def main(argv=None):
     apply_backend(args.backend)
     cfg = config_from_args(args)
 
+    from attacking_federate_learning_tpu.utils.backend import (
+        enable_compile_cache
+    )
+
+    enable_compile_cache()
+
     # Imported here so apply_backend ran before jax initialization.
     from attacking_federate_learning_tpu.attacks import make_attacker
     from attacking_federate_learning_tpu.core.engine import (

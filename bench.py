@@ -263,10 +263,11 @@ def bench_impl_table(G, f, on_accel, rtt=0.0, iters=4):
 
 def main():
     from attacking_federate_learning_tpu.utils.backend import (
-        ensure_live_backend
+        enable_compile_cache, ensure_live_backend
     )
 
     ensure_live_backend()
+    enable_compile_cache()
     import functools
 
     import jax
