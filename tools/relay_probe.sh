@@ -1,0 +1,14 @@
+# Shared relay-liveness probe (sourced by tpu_capture.sh and
+# relay_watch.sh).  Pure bash /dev/tcp — no Python interpreter (this
+# image's sitecustomize imports jax at startup; booting one per probe
+# would steal seconds of CPU per minute on an nproc=1 box).  Port list
+# mirrors relay_ports_listening (utils/backend.py).
+relay_probe() {
+  local p
+  for p in 8082 8083 8087; do
+    if timeout 2 bash -c "echo -n >/dev/tcp/127.0.0.1/$p" 2>/dev/null; then
+      return 0
+    fi
+  done
+  return 1
+}

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Poll the TPU relay every 45 s; on the first live probe, run the full
+# capture sequence (tools/tpu_capture.sh) exactly once per window.
+# Locking lives in tpu_capture.sh itself (rc=2 when another holder has
+# the TPU), so a manual capture and this watcher can never double-run.
+# State lands in logs/tpu_capture/watch.log.
+set -u
+cd "$(dirname "$0")/.."
+. tools/relay_probe.sh
+OUT=logs/tpu_capture
+mkdir -p "$OUT"
+WLOG="$OUT/watch.log"
+
+echo "$(date +%T) watcher start" >>"$WLOG"
+while true; do
+  if relay_probe; then
+    echo "$(date +%T) relay LIVE -> capture" >>"$WLOG"
+    bash tools/tpu_capture.sh >>"$OUT/capture_run.log" 2>&1
+    rc=$?
+    echo "$(date +%T) capture done rc=$rc" >>"$WLOG"
+    if [ "$rc" = 2 ]; then
+      sleep 120   # someone else holds the TPU; let them finish
+      continue
+    fi
+    # One capture per window: wait for the relay to go away before
+    # re-arming, so we don't immediately re-run on the same window.
+    while relay_probe; do sleep 60; done
+    echo "$(date +%T) relay gone; re-armed" >>"$WLOG"
+  fi
+  sleep 45
+done

@@ -7,23 +7,24 @@
 #   bash tools/tpu_capture.sh [--quick]
 #
 # --quick: bench only (for a window expected to be very short).
+#
+# Takes the TPU lock (one TPU process at a time on this box): exits 2 if
+# another capture/bench holds it.
 set -u
 cd "$(dirname "$0")/.."
+. tools/relay_probe.sh
 OUT=logs/tpu_capture
 mkdir -p "$OUT"
 STAMP=$(date +%H%M%S)
+LOCK=/tmp/tpu_capture.lock
 
-probe() {
-  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - <<'EOF'
-import sys
-from attacking_federate_learning_tpu.utils.backend import (
-    relay_ports_listening
-)
-sys.exit(0 if relay_ports_listening() else 1)
-EOF
-}
+if ! mkdir "$LOCK" 2>/dev/null; then
+  echo "TPU lock held ($LOCK); refusing to double-run" >&2
+  exit 2
+fi
+trap 'rmdir "$LOCK" 2>/dev/null' EXIT
 
-if ! probe; then echo "relay dead; aborting" >&2; exit 1; fi
+if ! relay_probe; then echo "relay dead; aborting" >&2; exit 1; fi
 
 echo "== step 1: bench.py (headline + 10k north star + per-impl) =="
 timeout 5400 python bench.py >"$OUT/bench_$STAMP.json" \
@@ -33,20 +34,20 @@ tail -30 "$OUT/bench_$STAMP.log"
 
 [ "${1:-}" = "--quick" ] && exit 0
 
-probe || { echo "relay died after bench" >&2; exit 1; }
+relay_probe || { echo "relay died after bench" >&2; exit 1; }
 echo "== step 2: TPU-backend test re-run (fused backdoor, Mosaic pallas,"
 echo "   engine) =="
 FL_TEST_TPU=1 timeout 3600 python -m pytest \
   tests/test_pallas.py tests/test_engine.py tests/test_parallel.py \
   -q --no-header 2>&1 | tee "$OUT/pytest_tpu_$STAMP.log" | tail -15
 
-probe || { echo "relay died after pytest" >&2; exit 1; }
+relay_probe || { echo "relay died after pytest" >&2; exit 1; }
 echo "== step 3: BASELINE cells 1-4 full scale =="
 timeout 7200 python -m attacking_federate_learning_tpu.benchmarks \
   --rounds 10 --cells 1,2,3,4 2>&1 \
   | tee "$OUT/cells_$STAMP.log" | grep -E '^\{' || true
 
-probe || { echo "relay died after cells 1-4" >&2; exit 1; }
+relay_probe || { echo "relay died after cells 1-4" >&2; exit 1; }
 echo "== step 4: 10k non-IID grid (cell 5, overnight north star) =="
 timeout 14400 python -m attacking_federate_learning_tpu.benchmarks \
   --rounds 10 --cells 5 2>&1 \
