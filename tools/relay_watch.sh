@@ -14,6 +14,15 @@ WLOG="$OUT/watch.log"
 echo "$(date +%T) watcher start" >>"$WLOG"
 while true; do
   if relay_probe; then
+    # Defer to the driver's end-of-round bench if it is already running
+    # — one TPU process at a time.  (CPU-pinned benchmark/test runs are
+    # fine to overlap; TPU-bound pytest/benchmarks runs are launched by
+    # tpu_capture.sh itself under the lock.)
+    if pgrep -f 'python bench\.py' >/dev/null; then
+      echo "$(date +%T) relay live but TPU busy; waiting" >>"$WLOG"
+      sleep 120
+      continue
+    fi
     echo "$(date +%T) relay LIVE -> capture" >>"$WLOG"
     bash tools/tpu_capture.sh >>"$OUT/capture_run.log" 2>&1
     rc=$?

@@ -8,8 +8,11 @@
 #
 # --quick: bench only (for a window expected to be very short).
 #
-# Takes the TPU lock (one TPU process at a time on this box): exits 2 if
-# another capture/bench holds it.
+# Serializes CAPTURES via a self-healing lock (exits 2 if a live holder
+# exists; a SIGKILLed holder's stale lock is reclaimed via its pid).
+# The lock does NOT cover a bare `python bench.py` — during a relay
+# window, use this script (or take the lock) instead of raw bench runs:
+# one TPU process at a time on this box.
 set -u
 cd "$(dirname "$0")/.."
 . tools/relay_probe.sh
@@ -18,11 +21,27 @@ mkdir -p "$OUT"
 STAMP=$(date +%H%M%S)
 LOCK=/tmp/tpu_capture.lock
 
-if ! mkdir "$LOCK" 2>/dev/null; then
-  echo "TPU lock held ($LOCK); refusing to double-run" >&2
+acquire() {
+  if mkdir "$LOCK" 2>/dev/null; then
+    echo $$ >"$LOCK/pid"
+    return 0
+  fi
+  local holder
+  holder=$(cat "$LOCK/pid" 2>/dev/null)
+  if [ -n "${holder:-}" ] && kill -0 "$holder" 2>/dev/null; then
+    return 1                       # live holder
+  fi
+  # Stale (holder gone or pid unreadable): reclaim.
+  rm -rf "$LOCK" 2>/dev/null
+  mkdir "$LOCK" 2>/dev/null && echo $$ >"$LOCK/pid"
+}
+
+if ! acquire; then
+  echo "TPU lock held by live pid $(cat "$LOCK/pid" 2>/dev/null); " \
+       "refusing to double-run" >&2
   exit 2
 fi
-trap 'rmdir "$LOCK" 2>/dev/null' EXIT
+trap 'rm -rf "$LOCK" 2>/dev/null' EXIT
 
 if ! relay_probe; then echo "relay dead; aborting" >&2; exit 1; fi
 
