@@ -151,6 +151,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "BLAS (CPU backend), or the blockwise shard_map "
                         "schedules over the clients mesh axis "
                         "(ring/allgather need --mesh-shape)")
+    p.add_argument("--distance-dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="dtype for the Krum/Bulyan distance computation "
+                        "only (training stays f32): bfloat16 rides the "
+                        "MXU at native throughput with f32 accumulation "
+                        "— a flagged deviation for the 10k regime")
     p.add_argument("--krum-paper-scoring", action="store_true",
                    help="paper-faithful Krum scoring (n-f-2 closest) instead "
                         "of the reference's n-f (defences.py:26)")
@@ -216,6 +222,7 @@ def config_from_args(args) -> ExperimentConfig:
         krum_paper_scoring=args.krum_paper_scoring,
         krum_scoring_method=args.krum_scoring_method,
         distance_impl=args.distance_impl,
+        distance_dtype=args.distance_dtype,
         bulyan_batch_select=args.bulyan_batch_select,
         server_uses_faded_lr=args.server_uses_faded_lr,
         log_round_stats=args.round_stats,

@@ -165,6 +165,14 @@ class ExperimentConfig:
     #   'allgather' one all_gather + per-device tiles
     # (ring/allgather require a device mesh, parallel/distances.py).
     distance_impl: str = "auto"
+    # Distance computation dtype (defenses/kernels.py:_distances_for):
+    # 'bfloat16' casts the (n, d) operand for the DISTANCE computation
+    # only — the Gram matmul rides the MXU at native bf16 throughput
+    # (vs the multi-pass f32 HIGHEST emulation) with f32 accumulation
+    # and f32 norms; training numerics are untouched.  An explicit,
+    # flagged deviation for the 10k north-star regime; 'float32' (the
+    # default) is reference-parity.  Ignored by the 'host' engine.
+    distance_dtype: str = "float32"
     # Bulyan selection batching (defenses/kernels.py:bulyan): q>1 is an
     # explicit, flagged relaxation of the reference's strictly sequential
     # selection for the large-n regime — each trip selects the q
@@ -216,6 +224,10 @@ class ExperimentConfig:
             raise ValueError(
                 f"distance_impl must be one of auto/xla/pallas/host/ring/"
                 f"allgather, got {self.distance_impl!r}")
+        if self.distance_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"distance_dtype must be 'float32' or 'bfloat16', "
+                f"got {self.distance_dtype!r}")
         if self.data_placement not in ("device", "host_stream"):
             raise ValueError(
                 f"data_placement must be 'device' or 'host_stream', "

@@ -192,6 +192,8 @@ class FederatedExperiment:
         kw = {"method": cfg.krum_scoring_method}
         if cfg.krum_paper_scoring:
             kw["paper_scoring"] = True
+        if cfg.distance_dtype != "float32":
+            kw["distance_dtype"] = cfg.distance_dtype
         bulyan_kw = ({"batch_select": cfg.bulyan_batch_select}
                      if (cfg.defense == "Bulyan"
                          and cfg.bulyan_batch_select != 1) else {})
@@ -220,8 +222,13 @@ class FederatedExperiment:
                     f"divisible by the clients mesh axis (m={self.m}, "
                     f"axis={p})")
 
+            # Blockwise tiles share cross_sq_distances, so bf16 operands
+            # ride the MXU inside the shard_map too (f32 accumulation).
+            dist_dtype = jnp.dtype(cfg.distance_dtype)
+
             def with_blockwise_D(grads, n, f, _fn=fn, **extra):
-                D = dist_fn(grads.astype(jnp.float32), mesh)
+                extra.pop("distance_dtype", None)  # D is precomputed
+                D = dist_fn(grads.astype(dist_dtype), mesh)
                 return _fn(grads, n, f, D=D, **extra)
 
             if cfg.defense == "Krum":

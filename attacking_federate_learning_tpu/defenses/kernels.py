@@ -66,13 +66,28 @@ def resolve_distance_impl(distance_impl, users_count=None, users_grads=None):
     return "host" if jax.default_backend() == "cpu" else "xla"
 
 
-def _distances_for(users_grads, impl):
-    """Distance matrix (zero diagonal) via the selected engine."""
+def _distances_for(users_grads, impl, distance_dtype=None):
+    """Distance matrix (zero diagonal) via the selected engine.
+
+    ``distance_dtype='bfloat16'``: cast the operand for the distance
+    computation ONLY — the Gram rides the MXU at native bf16 throughput
+    (vs the ~6-pass f32 HIGHEST emulation) with f32 accumulation and f32
+    squared norms (ops/distances.py).  Training numerics are untouched;
+    this is a flagged opt-in deviation like the other quirk knobs (off
+    by default; the 'host' engine ignores it — host BLAS is f32)."""
+    if distance_dtype is not None:
+        users_grads = users_grads.astype(jnp.dtype(distance_dtype))
     if impl == "pallas":
         from attacking_federate_learning_tpu.ops.pallas_distances import (
             pallas_pairwise_distances
         )
-        return pallas_pairwise_distances(users_grads.astype(jnp.float32))
+        if distance_dtype is None:
+            # Preserve pre-flag semantics: without an explicit
+            # distance_dtype the pallas path always computed f32, even
+            # for a bf16 wire matrix (the xla path, by documented
+            # contract, rides the wire dtype — ops/distances.py).
+            users_grads = users_grads.astype(jnp.float32)
+        return pallas_pairwise_distances(users_grads)
     return pairwise_distances(users_grads)
 
 
@@ -212,7 +227,7 @@ def _host_krum_index(users_grads, users_count, corrupted_count,
 
 def krum_select(users_grads, users_count, corrupted_count,
                 paper_scoring=False, method="sort", distance_impl="xla",
-                D=None):
+                D=None, distance_dtype=None):
     """Index of the Krum winner (reference ``krum(..., return_index=True)``,
     defences.py:39-40).  :func:`krum` is defined through this, so the
     selection the engine's round diagnostics report is — by construction —
@@ -223,7 +238,7 @@ def krum_select(users_grads, users_count, corrupted_count,
         if impl == "host":
             return _host_krum_index(users_grads, users_count,
                                     corrupted_count, paper_scoring)
-        D = _distances_for(users_grads, impl)
+        D = _distances_for(users_grads, impl, distance_dtype)
     scores = _krum_scores(D, users_count, corrupted_count,
                           paper_scoring=paper_scoring, method=method)
     return jnp.argmin(scores)
@@ -231,7 +246,7 @@ def krum_select(users_grads, users_count, corrupted_count,
 
 @DEFENSES.register("Krum")
 def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
-         method="sort", distance_impl="xla", D=None):
+         method="sort", distance_impl="xla", D=None, distance_dtype=None):
     """Krum selection (reference defences.py:23-42): the single gradient
     whose summed distance to its k nearest peers is minimal.
 
@@ -241,12 +256,14 @@ def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
     (host on CPU, xla elsewhere).  ``D``: precomputed (n, n) distance matrix
     with zero diagonal — the engine passes one from the blockwise shard_map
     kernels (parallel/distances.py) for distance_impl in {ring, allgather}.
+    ``distance_dtype``: see :func:`_distances_for` (bf16 MXU mode).
     """
     return users_grads[krum_select(users_grads, users_count,
                                    corrupted_count,
                                    paper_scoring=paper_scoring,
                                    method=method,
-                                   distance_impl=distance_impl, D=D)]
+                                   distance_impl=distance_impl, D=D,
+                                   distance_dtype=distance_dtype)]
 
 
 def trimmed_mean_of(users_grads, number_to_consider):
@@ -273,7 +290,8 @@ def trimmed_mean(users_grads, users_count, corrupted_count):
 
 @DEFENSES.register("Bulyan")
 def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
-           method="sort", distance_impl="xla", D=None, batch_select=1):
+           method="sort", distance_impl="xla", D=None, batch_select=1,
+           distance_dtype=None):
     """Bulyan (reference defences.py:55-70): iteratively Krum-select
     n - 2f gradients (removing each winner from the pool, with the pool
     size — but not f — shrinking), then trim-mean the selection with
@@ -317,7 +335,7 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
                 host_fn = functools.partial(host_bulyan, batch_select=q)
             return _host_defense(host_fn, users_grads, users_count,
                                  corrupted_count, paper_scoring)
-        D = _distances_for(users_grads, impl)
+        D = _distances_for(users_grads, impl, distance_dtype)
 
     # Presort once: +inf diagonal reproduces the reference's no-self-
     # distance dict (defences.py:16-21).

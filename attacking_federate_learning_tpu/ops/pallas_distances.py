@@ -64,11 +64,16 @@ def pallas_pairwise_distances(G, bm=128, bn=128, bk=512, interpret=None):
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
     n, d = G.shape
+    # bf16 inputs keep their dtype into the matmul (MXU-native throughput,
+    # f32 accumulation via preferred_element_type in _dist_kernel); norms
+    # are always f32.  Everything else computes in f32.
+    if G.dtype != jnp.bfloat16:
+        G = G.astype(jnp.float32)
     # lcm: rows enter the grid as both i-blocks (bm) and j-blocks (bn); a
     # max() pad would leave output tiles unwritten when bm != bn.
-    Gp = _pad_to(_pad_to(G.astype(jnp.float32), 1, bk), 0, math.lcm(bm, bn))
+    Gp = _pad_to(_pad_to(G, 1, bk), 0, math.lcm(bm, bn))
     np_, dp = Gp.shape
-    sq = jnp.sum(Gp * Gp, axis=1)
+    sq = jnp.sum(Gp.astype(jnp.float32) * Gp.astype(jnp.float32), axis=1)
     sq_col = sq[:, None]                      # (np, 1) row norms
     sq_row = sq[None, :]                      # (1, np) col norms
     nk = dp // bk

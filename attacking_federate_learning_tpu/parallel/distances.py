@@ -70,7 +70,11 @@ def pairwise_distances_ring(G, mesh, axis=CLIENTS):
 
         # pcast-to-varying: the accumulator is device-varying (holds
         # per-shard tiles); jax 0.9 scans require the carry marked so.
-        out0 = lax.pcast(jnp.zeros((blk, n), gb.dtype), axis, to="varying")
+        # f32 always: the cross_sq_distances tiles accumulate f32 even
+        # for bf16 operands (distance_dtype='bfloat16'), and the carry
+        # must match the tile dtype.
+        out0 = lax.pcast(jnp.zeros((blk, n), jnp.float32), axis,
+                         to="varying")
         src0 = jnp.asarray(me, jnp.int32)
         (_, _, out), _ = lax.scan(step, (gb, src0, out0), None, length=p)
         return out

@@ -116,7 +116,8 @@ def test_resolve_auto():
 # engine wiring: cfg.distance_impl reaches the defense, including the
 # blockwise shard_map engines over the 8-virtual-device mesh
 # --------------------------------------------------------------------------
-def _one_round_weights(distance_impl, mesh_shape=None, defense="Krum"):
+def _one_round_weights(distance_impl, mesh_shape=None, defense="Krum",
+                       distance_dtype="float32"):
     from attacking_federate_learning_tpu import config as C
     from attacking_federate_learning_tpu.attacks import DriftAttack
     from attacking_federate_learning_tpu.config import ExperimentConfig
@@ -128,6 +129,7 @@ def _one_round_weights(distance_impl, mesh_shape=None, defense="Krum"):
     cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=16,
                            mal_prop=0.2, batch_size=16, epochs=2,
                            defense=defense, distance_impl=distance_impl,
+                           distance_dtype=distance_dtype,
                            mesh_shape=mesh_shape,
                            synth_train=1024, synth_test=128)
     ds = load_dataset(cfg.dataset, seed=0, synth_train=1024, synth_test=128)
@@ -207,3 +209,98 @@ def test_engine_ring_bf16_parity():
 
     np.testing.assert_allclose(weights("ring", (8, 1)),
                                weights("xla", None), atol=2e-5, rtol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# distance_dtype='bfloat16': the bf16-Gram MXU mode (round-3 flag) — cast
+# for the distance computation only, f32 accumulation + f32 norms
+# --------------------------------------------------------------------------
+def test_bf16_distances_close_to_f32():
+    from attacking_federate_learning_tpu.ops.distances import (
+        pairwise_distances
+    )
+
+    G = grads_for(32, 500, seed=5)
+    want = np.asarray(pairwise_distances(jnp.asarray(G)))
+    got = np.asarray(pairwise_distances(jnp.asarray(G, jnp.bfloat16)))
+    assert got.dtype == np.float32  # accumulation/norms stay f32
+    # bf16 multiplies: ~0.4% per-element relative error, averaged down by
+    # the d-length accumulation.
+    np.testing.assert_allclose(got, want, atol=0.05, rtol=2e-2)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_krum_select_bf16_agrees(impl):
+    """On generic (non-tie) data the bf16-Gram selection matches f32 —
+    eager and jitted — for both the XLA and pallas engines."""
+    G = jnp.asarray(grads_for(24, 300, seed=9))
+    want = int(K.krum_select(G, 24, 5))
+    got = int(K.krum_select(G, 24, 5, distance_impl=impl,
+                            distance_dtype="bfloat16"))
+    assert got == want
+    jit_sel = jax.jit(K.krum_select, static_argnums=(1, 2),
+                      static_argnames=("distance_impl", "distance_dtype"))
+    assert int(jit_sel(G, 24, 5, distance_impl=impl,
+                       distance_dtype="bfloat16")) == want
+
+
+def test_bulyan_bf16_close_to_f32():
+    """On separated data (tight honest cluster, far malicious rows) the
+    bf16-Gram selection picks the same set, so outputs match to bf16
+    tolerance.  (On knife-edge iid data the discrete selection can
+    legitimately differ between dtypes — that's inherent to any
+    selection defense under a distance perturbation, not a bug.)"""
+    rng = np.random.default_rng(11)
+    base = rng.standard_normal(200).astype(np.float32)
+    G = base + 0.05 * rng.standard_normal((31, 200)).astype(np.float32)
+    G[:5] += 10.0  # malicious rows far from the honest cluster
+    G = jnp.asarray(G)
+    want = np.asarray(K.bulyan(G, 31, 5))
+    got = np.asarray(K.bulyan(G, 31, 5, distance_dtype="bfloat16"))
+    # Near-tied honest rows may swap a marginal selection between dtypes;
+    # the bound is a fraction of the honest-cluster spread (0.05) — far
+    # below the 10.0 malicious offset any contamination would show.
+    np.testing.assert_allclose(got, want, atol=0.1, rtol=2e-2)
+    assert float(np.max(np.abs(got - np.asarray(base)))) < 1.0
+
+
+def test_engine_distance_dtype_bf16():
+    """cfg.distance_dtype reaches the kernels through the engine wiring;
+    the fused round runs and matches the f32 run closely (selection on
+    well-separated synth gradients is dtype-robust)."""
+    ref = _one_round_weights("xla")
+    got = _one_round_weights("xla", distance_dtype="bfloat16")
+    np.testing.assert_allclose(got, ref, atol=1e-3, rtol=1e-3)
+
+
+@pytest.mark.parametrize("impl", ["allgather", "ring"])
+def test_engine_distance_dtype_bf16_blockwise(impl):
+    # ring regression: the scan carry must be f32 even for bf16 operands
+    # (parallel/distances.py) — bf16 tiles never exist.
+    ref = _one_round_weights(impl, mesh_shape=(8, 1))
+    got = _one_round_weights(impl, mesh_shape=(8, 1),
+                             distance_dtype="bfloat16")
+    np.testing.assert_allclose(got, ref, atol=1e-3, rtol=1e-3)
+
+
+def test_pallas_default_distance_dtype_stays_f32_for_bf16_wire():
+    """grad_dtype=bfloat16 + distance_impl=pallas WITHOUT the flag must
+    keep the pre-flag f32 distance math (change behavior only behind
+    flags): pallas distances from a bf16 wire matrix equal those of its
+    f32 upcast exactly."""
+    from attacking_federate_learning_tpu.defenses.kernels import (
+        _distances_for
+    )
+
+    G16 = jnp.asarray(grads_for(16, 128, seed=21), jnp.bfloat16)
+    want = np.asarray(_distances_for(G16.astype(jnp.float32), "pallas"))
+    got = np.asarray(_distances_for(G16, "pallas"))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_distance_dtype_validation():
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+
+    with pytest.raises(ValueError, match="distance_dtype"):
+        ExperimentConfig(dataset="SYNTH_MNIST", users_count=8,
+                         distance_dtype="float16")
