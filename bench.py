@@ -476,10 +476,16 @@ def main():
               f"staged={backdoor_rps(False):.2f} "
               f"(32 clients, pattern trigger, TrimmedMean)")
 
-    # Recap block last so the driver's stderr tail records the story.
+    # Recap block last so the driver's stderr tail records the story;
+    # the essentials repeat at the very end in case the tail is capped.
     log("=== bench recap ===")
     for line in RECAP:
         log(line)
+    log("=== essentials ===")
+    for line in RECAP:
+        if ("device:" in line or "framework krum" in line
+                or "north-star" in line or "mfu[krum" in line):
+            log(line)
 
     emit_result_json()
 
