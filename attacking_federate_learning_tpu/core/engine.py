@@ -650,6 +650,11 @@ class FederatedExperiment:
 
         if timer is not None:
             logger.record(kind="profile", phases=timer.summary())
+        if self._streaming:
+            # Did the host gather/transfer sit on the round path?
+            # (VERDICT r2 #3's stream-stall measurement; near-zero stall
+            # per get means the prefetch pipeline kept up.)
+            logger.record(kind="stream", **self.stream.stall_stats())
         logger.finish()
         return {"accuracies": logger.accuracies,
                 "epochs": logger.accuracies_epochs,

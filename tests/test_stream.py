@@ -150,3 +150,39 @@ def test_deep_prefetch_cache_bound_and_order():
         stream._pool.shutdown(wait=True)
     with pytest.raises(ValueError, match="stream_prefetch"):
         ExperimentConfig(stream_prefetch=0)
+
+
+def test_stall_stats_recorded():
+    """get() accumulates stall wall-time and cold-miss counts, and a
+    streamed run writes one 'stream' record to the JSONL log."""
+    import json
+
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+    from attacking_federate_learning_tpu.utils.metrics import RunLogger
+
+    cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=8,
+                           mal_prop=0.0, batch_size=8, epochs=3,
+                           defense="NoDefense",
+                           data_placement="host_stream",
+                           synth_train=512, synth_test=64)
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=512, synth_test=64)
+    exp = FederatedExperiment(cfg, dataset=ds)
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        logger = RunLogger(cfg, None, td)
+        exp.run(logger=logger)
+        stats = exp.stream.stall_stats()
+        assert stats["stream_gets"] == 3
+        assert stats["stream_cold_misses"] >= 1    # round 0 is always cold
+        assert stats["stream_stall_s"] >= 0.0
+        recs = []
+        import glob
+        for p in glob.glob(td + "/*.jsonl"):
+            with open(p) as fh:
+                recs += [json.loads(line) for line in fh]
+        assert any(r.get("kind") == "stream" for r in recs)
