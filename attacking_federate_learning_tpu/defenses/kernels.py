@@ -307,15 +307,19 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
     as :func:`krum`.
 
     ``batch_select=q`` is an explicit, flagged relaxation for the
-    large-n regime (the 10k north star), where the reference's strictly
-    sequential selection is O(n) iterations of O(n^2) scoring by its
-    nature (BASELINE.md): each trip selects the q lowest-scoring alive
-    clients against the SAME scores, re-scoring only between trips, so
-    the loop runs ceil(set_size/q) trips instead of set_size.  q=1 IS
-    the reference semantics (ties resolve to the lowest index either
-    way: ``lax.top_k`` breaks ties toward lower indices, matching
+    large-n regime on the *traced/XLA* path, where the reference's
+    strictly sequential selection is O(n) iterations of O(n^2) scoring
+    (BASELINE.md): each trip selects the q lowest-scoring alive clients
+    against the SAME scores, re-scoring only between trips, so the loop
+    runs ceil(set_size/q) trips instead of set_size.  q=1 IS the
+    reference semantics (ties resolve to the lowest index either way:
+    ``lax.top_k`` breaks ties toward lower indices, matching
     first-occurrence ``np.argmin``) — the default, and what every
-    oracle/reference-parity test pins."""
+    oracle/reference-parity test pins.  On the ``host`` impl, exact q=1
+    no longer needs the relaxation at scale: the native incremental
+    kernel (native/bulyan_select.cpp) maintains every row's prefix score
+    in O(1) amortized per selection, making the whole exact selection
+    O(n^2) total instead of O(n^2) per step."""
     n, _ = users_grads.shape
     f = corrupted_count
     set_size = users_count - 2 * f

@@ -410,6 +410,30 @@ def main():
         del G10
     elif on_accel:
         recap("north-star suite SKIPPED: relay died before it could run")
+    else:
+        # CPU fallback still proves the exact-semantics north star: the
+        # native incremental selection (native/bulyan_select.cpp) makes
+        # reference-exact q=1 Bulyan O(n^2) total — minutes, not hours,
+        # on one core (vs ~6 h extrapolated for the rescore loop).
+        with phase("north-star-bulyan-exact-host", 900):
+            from attacking_federate_learning_tpu.defenses.host import (
+                host_bulyan
+            )
+            from attacking_federate_learning_tpu.native import get_lib
+            if get_lib() is None:
+                # NumPy-fallback exact selection is multi-hour at 10k —
+                # don't burn the phase budget discovering that.
+                recap("north-star: bulyan exact host SKIPPED "
+                      "(native kernel unavailable)")
+            else:
+                G10h = rng.standard_normal((N_NORTH, DIM),
+                                           dtype=np.float32)
+                t0 = time.perf_counter()
+                host_bulyan(G10h, N_NORTH, f10)
+                s_b1 = time.perf_counter() - t0
+                recap(f"north-star: bulyan[q=1 exact, host native] @ "
+                      f"{N_NORTH}: {s_b1:.1f} s")
+                del G10h
 
     # --- secondary: full FL round throughput (stderr diagnostic) --------
     with phase("fl-throughput", 600):

@@ -338,3 +338,50 @@ def test_topk_guard_fails_on_rowsum_overflow():
     np.testing.assert_array_equal(auto_scores, sort_scores)
     np.testing.assert_array_equal(topk_scores, sort_scores)
     assert int(np.argmin(auto_scores)) == 4
+
+
+def test_host_trimmed_mean_partition_matches_stable_sort():
+    """host_trimmed_mean_of's native evaluation must equal the
+    definitional stable-sort form — including at boundary ties, where the
+    stable order keeps the LOWEST row indices (e.g. +x before -x when
+    |dev| ties), which changes the kept *values*.  Skipped when the
+    native kernel is unavailable: the fallback IS the stable-sort form,
+    so the comparison would be vacuous."""
+    from attacking_federate_learning_tpu.defenses.host import (
+        host_trimmed_mean_of,
+    )
+    from attacking_federate_learning_tpu.native import get_lib
+
+    if get_lib() is None:
+        pytest.skip("native kernel unavailable (no g++?)")
+
+    def stable_sort_form(sel, k):
+        med = np.median(sel, axis=0)
+        dev = sel - med
+        order = np.argsort(np.abs(dev), axis=0, kind="stable")
+        kept = np.take_along_axis(dev, order[:k], axis=0)
+        return (kept.mean(axis=0) + med).astype(np.float32)
+
+    rng = np.random.default_rng(0)
+    for n, d in [(5, 7), (12, 31), (33, 10), (6, 1)]:
+        for k in [1, 2, n // 2, n - 1, n]:
+            sel = rng.standard_normal((n, d)).astype(np.float32)
+            np.testing.assert_allclose(
+                host_trimmed_mean_of(sel, k), stable_sort_form(sel, k),
+                rtol=1e-6, atol=1e-6)
+    # Engineered symmetric ties: rows at med±x have identical |dev|;
+    # the stable order keeps the earlier ROW, so sign matters.
+    sel = np.array([[1.0], [3.0], [2.0], [1.0], [3.0], [2.0]], np.float32)
+    for k in range(1, 7):
+        # rtol covers the native kernel's f64-accumulated mean (<=1 ulp
+        # vs NumPy's f32 mean); a tie-handling bug would be O(x), not ulp.
+        np.testing.assert_allclose(
+            host_trimmed_mean_of(sel, k), stable_sort_form(sel, k),
+            rtol=1e-6, atol=1e-7)
+    # Duplicated boundary values across many rows.
+    sel = np.tile(np.array([[2.0], [0.0], [4.0], [2.0]], np.float32),
+                  (3, 5))
+    for k in range(1, sel.shape[0] + 1):
+        np.testing.assert_allclose(
+            host_trimmed_mean_of(sel, k), stable_sort_form(sel, k),
+            rtol=1e-6, atol=1e-7)
