@@ -196,6 +196,11 @@ class ExperimentConfig:
     dnc_iters: int = 5
     dnc_sketch_dim: int = 2048
     dnc_filter_frac: float = 1.5
+    # TrimmedMean kernel: 'xla' (default — keeps staged/fused rounds on
+    # the same kernel, preserving bit-identity) or 'host' (opt-in: the
+    # native column-blocked kernel, ~minutes -> ~25 s at the 10k scale
+    # on the CPU backend; defenses/kernels.py:trimmed_mean).
+    trimmed_mean_impl: str = "xla"
 
     # --- metadata subsystem (reference C12, vestigial there) ------------
     collect_metadata: bool = False
@@ -251,6 +256,10 @@ class ExperimentConfig:
         if self.dnc_filter_frac <= 0:
             raise ValueError(
                 f"dnc_filter_frac must be > 0, got {self.dnc_filter_frac}")
+        if self.trimmed_mean_impl not in ("xla", "host"):
+            raise ValueError(
+                f"trimmed_mean_impl must be 'xla' or 'host', "
+                f"got {self.trimmed_mean_impl!r}")
         if self.local_steps < 1:
             raise ValueError(
                 f"local_steps must be >= 1, got {self.local_steps}")

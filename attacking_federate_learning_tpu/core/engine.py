@@ -94,6 +94,12 @@ class FederatedExperiment:
         self.defense_fn = DEFENSES[cfg.defense]
         if cfg.defense in ("Krum", "Bulyan"):
             self.defense_fn = self._wire_distance_defense(self.defense_fn)
+        elif (cfg.defense == "TrimmedMean"
+                and cfg.trimmed_mean_impl != "xla"):
+            # Opt-in native host kernel (defenses/kernels.py:trimmed_mean
+            # explains why this is not auto-dispatched).
+            self.defense_fn = functools.partial(
+                self.defense_fn, impl=cfg.trimmed_mean_impl)
         elif cfg.defense == "DnC":
             # DnC's constants are config surface (the most constant-
             # sensitive defense), and its sketch keys flow from the

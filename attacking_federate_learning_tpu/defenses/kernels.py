@@ -282,9 +282,38 @@ def trimmed_mean_of(users_grads, number_to_consider):
 
 
 @DEFENSES.register("TrimmedMean")
-def trimmed_mean(users_grads, users_count, corrupted_count):
-    """Reference defences.py:44-52; keeps n - f - 1 coordinates."""
+def trimmed_mean(users_grads, users_count, corrupted_count, impl="xla"):
+    """Reference defences.py:44-52; keeps n - f - 1 coordinates.
+
+    ``impl='host'`` (opt-in, config ``trimmed_mean_impl``) routes to the
+    native column-blocked kernel (defenses/host.py ->
+    native/bulyan_select.cpp:fl_trimmed_mean): at n=10,240, d=79,510 the
+    XLA:CPU per-coordinate stable sort is minutes while the native
+    kernel is ~25 s.  Unlike Krum's host path (which returns an exact
+    input row, so dispatch cannot change results), the host trimmed
+    mean differs from XLA by summation-order ulps — which is why it is
+    NOT auto-dispatched: the staged/fused bit-identity invariant
+    (tests/test_engine.py::test_backdoor_fused_equals_staged) holds
+    only when both modes run the same kernel."""
     number_to_consider = users_grads.shape[0] - corrupted_count - 1
+    if impl == "host":
+        from attacking_federate_learning_tpu.defenses.host import (
+            host_trimmed_mean_of
+        )
+        import numpy as np
+
+        d = users_grads.shape[-1]
+        k_static = int(number_to_consider)
+
+        def cb(g):
+            return host_trimmed_mean_of(
+                np.asarray(g, np.float32), k_static).astype(np.float32)
+
+        if not isinstance(users_grads, jax.core.Tracer):
+            return jnp.asarray(cb(users_grads))
+        return jax.pure_callback(cb,
+                                 jax.ShapeDtypeStruct((d,), jnp.float32),
+                                 users_grads.astype(jnp.float32))
     return trimmed_mean_of(users_grads, number_to_consider)
 
 

@@ -298,3 +298,47 @@ def test_dnc_fresh_sketches_per_round_and_fallback():
         out = np.asarray(dnc(H, 8, 3, round=seed))
         assert np.isfinite(out).all()
         assert np.linalg.norm(out) > 0.01    # not the silent zero update
+
+
+def test_trimmed_mean_host_impl_matches_xla():
+    """trimmed_mean_impl='host' is opt-in config surface: the engine
+    wires the partial, the host/native kernel agrees with the XLA kernel
+    within summation-order tolerance, and the default stays 'xla' (the
+    staged/fused bit-identity invariant depends on it)."""
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+    from attacking_federate_learning_tpu.defenses.kernels import (
+        trimmed_mean
+    )
+
+    rng = np.random.default_rng(0)
+    G = jnp.asarray(rng.standard_normal((24, 4096)).astype(np.float32))
+    via_xla = np.asarray(trimmed_mean(G, 24, 5))
+    via_host = np.asarray(trimmed_mean(G, 24, 5, impl="host"))
+    np.testing.assert_allclose(via_host, via_xla, rtol=1e-5, atol=1e-6)
+    # Inside a jit the host impl goes through pure_callback.
+    via_host_jit = np.asarray(
+        jax.jit(lambda g: trimmed_mean(g, 24, 5, impl="host"))(G))
+    np.testing.assert_allclose(via_host_jit, via_host, rtol=0, atol=0)
+
+    cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=8,
+                           mal_prop=0.25, batch_size=16, epochs=1,
+                           defense="TrimmedMean",
+                           trimmed_mean_impl="host",
+                           synth_train=256, synth_test=64)
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=256, synth_test=64)
+    exp = FederatedExperiment(cfg, dataset=ds)
+    assert exp.defense_fn.keywords["impl"] == "host"
+    exp.run_span(0, 1)
+    assert np.isfinite(np.asarray(exp.state.weights)).all()
+
+    default_cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=8,
+                                   mal_prop=0.25, defense="TrimmedMean",
+                                   synth_train=256, synth_test=64)
+    assert default_cfg.trimmed_mean_impl == "xla"
+    with pytest.raises(ValueError):
+        ExperimentConfig(trimmed_mean_impl="native")
