@@ -59,6 +59,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="TrimmedMean kernel: traced XLA (default) or the "
                         "opt-in native host kernel (fast at 10k clients "
                         "on the CPU backend)")
+    p.add_argument("--median-impl",
+                   default=ExperimentConfig.median_impl,
+                   choices=["xla", "host"],
+                   help="Median kernel: traced XLA (default) or the "
+                        "opt-in native host kernel")
     p.add_argument("-s", "--dataset", default=C.MNIST,
                    choices=[C.MNIST, C.CIFAR10, C.CIFAR100, C.SYNTH_MNIST,
                             C.SYNTH_CIFAR10, C.SYNTH_MNIST_HARD],
@@ -241,6 +246,7 @@ def config_from_args(args) -> ExperimentConfig:
         dnc_sketch_dim=args.dnc_sketch_dim,
         dnc_filter_frac=args.dnc_filter_frac,
         trimmed_mean_impl=args.trimmed_mean_impl,
+        median_impl=args.median_impl,
     )
 
 

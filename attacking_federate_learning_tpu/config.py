@@ -196,11 +196,13 @@ class ExperimentConfig:
     dnc_iters: int = 5
     dnc_sketch_dim: int = 2048
     dnc_filter_frac: float = 1.5
-    # TrimmedMean kernel: 'xla' (default — keeps staged/fused rounds on
-    # the same kernel, preserving bit-identity) or 'host' (opt-in: the
-    # native column-blocked kernel, ~minutes -> ~25 s at the 10k scale
-    # on the CPU backend; defenses/kernels.py:trimmed_mean).
+    # Coordinate-wise kernels: 'xla' (default — keeps staged/fused
+    # rounds on the same kernel, preserving bit-identity) or 'host'
+    # (opt-in: the native column-blocked kernels, ~minutes -> ~25 s at
+    # the 10k scale on the CPU backend; defenses/kernels.py:trimmed_mean,
+    # defenses/median.py).
     trimmed_mean_impl: str = "xla"
+    median_impl: str = "xla"
 
     # --- metadata subsystem (reference C12, vestigial there) ------------
     collect_metadata: bool = False
@@ -260,6 +262,10 @@ class ExperimentConfig:
             raise ValueError(
                 f"trimmed_mean_impl must be 'xla' or 'host', "
                 f"got {self.trimmed_mean_impl!r}")
+        if self.median_impl not in ("xla", "host"):
+            raise ValueError(
+                f"median_impl must be 'xla' or 'host', "
+                f"got {self.median_impl!r}")
         if self.local_steps < 1:
             raise ValueError(
                 f"local_steps must be >= 1, got {self.local_steps}")

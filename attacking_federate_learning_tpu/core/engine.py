@@ -100,6 +100,9 @@ class FederatedExperiment:
             # explains why this is not auto-dispatched).
             self.defense_fn = functools.partial(
                 self.defense_fn, impl=cfg.trimmed_mean_impl)
+        elif cfg.defense == "Median" and cfg.median_impl != "xla":
+            self.defense_fn = functools.partial(
+                self.defense_fn, impl=cfg.median_impl)
         elif cfg.defense == "DnC":
             # DnC's constants are config surface (the most constant-
             # sensitive defense), and its sketch keys flow from the

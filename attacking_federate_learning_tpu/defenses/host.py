@@ -95,6 +95,20 @@ def host_krum(G, users_count, corrupted_count, paper_scoring=False):
                              paper_scoring=paper_scoring)]
 
 
+def host_median(sel: np.ndarray):
+    """Coordinate-wise median (defenses/median.py host path): the native
+    column-blocked kernel when available AND the input is fully finite
+    (std::nth_element on NaN is undefined behavior, and np.median's
+    NaN-propagation must be preserved); np.median otherwise."""
+    sel = np.asarray(sel, np.float32)
+    if sel.size and np.isfinite(sel).all():
+        from attacking_federate_learning_tpu.native import native_median
+        out = native_median(sel)
+        if out is not None:
+            return out
+    return np.median(sel, axis=0).astype(np.float32)
+
+
 def host_trimmed_mean_of(sel: np.ndarray, number_to_consider: int):
     """Median-anchored trimmed mean (reference defences.py:48-51), stable
     order on |deviation| to match Python's stable ``sorted``.

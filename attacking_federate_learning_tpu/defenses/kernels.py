@@ -300,21 +300,28 @@ def trimmed_mean(users_grads, users_count, corrupted_count, impl="xla"):
         from attacking_federate_learning_tpu.defenses.host import (
             host_trimmed_mean_of
         )
-        import numpy as np
-
-        d = users_grads.shape[-1]
         k_static = int(number_to_consider)
-
-        def cb(g):
-            return host_trimmed_mean_of(
-                np.asarray(g, np.float32), k_static).astype(np.float32)
-
-        if not isinstance(users_grads, jax.core.Tracer):
-            return jnp.asarray(cb(users_grads))
-        return jax.pure_callback(cb,
-                                 jax.ShapeDtypeStruct((d,), jnp.float32),
-                                 users_grads.astype(jnp.float32))
+        return host_coordwise(
+            lambda g: host_trimmed_mean_of(g, k_static), users_grads)
     return trimmed_mean_of(users_grads, number_to_consider)
+
+
+def host_coordwise(host_fn, users_grads):
+    """Dispatch a coordinate-wise defenses/host.py kernel
+    (``(n, d) f32 -> (d,) f32``): zero-copy eager call on concrete
+    operands, ``pure_callback`` inside traced programs — the shared
+    scaffold for the opt-in 'host' impls of TrimmedMean and Median."""
+    import numpy as np
+
+    d = users_grads.shape[-1]
+
+    def cb(g):
+        return host_fn(np.asarray(g, np.float32)).astype(np.float32)
+
+    if not isinstance(users_grads, jax.core.Tracer):
+        return jnp.asarray(cb(users_grads))
+    return jax.pure_callback(cb, jax.ShapeDtypeStruct((d,), jnp.float32),
+                             users_grads.astype(jnp.float32))
 
 
 @DEFENSES.register("Bulyan")

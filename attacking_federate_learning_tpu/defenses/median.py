@@ -14,5 +14,17 @@ from attacking_federate_learning_tpu.defenses.kernels import DEFENSES
 
 
 @DEFENSES.register("Median")
-def median(users_grads, users_count, corrupted_count):
+def median(users_grads, users_count, corrupted_count, impl="xla"):
+    """``impl='host'`` (opt-in, config ``median_impl``) routes to the
+    native column-blocked kernel (native/bulyan_select.cpp:fl_median) —
+    same rationale and same non-auto-dispatch rule as
+    kernels.py:trimmed_mean."""
+    if impl == "host":
+        from attacking_federate_learning_tpu.defenses.host import (
+            host_median
+        )
+        from attacking_federate_learning_tpu.defenses.kernels import (
+            host_coordwise
+        )
+        return host_coordwise(host_median, users_grads)
     return jnp.median(users_grads, axis=0)

@@ -61,6 +61,13 @@ def _build_and_load():
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
     ]
+    md = lib.fl_median
+    md.restype = ctypes.c_int
+    md.argtypes = [
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        ctypes.c_int32, ctypes.c_int32,
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+    ]
     return lib
 
 
@@ -100,6 +107,22 @@ def native_bulyan_selection(D, order, users_count, corrupted_count,
         int(set_size), int(max(1, batch_select)),
         1 if paper_scoring else 0, out,
     )
+    if rc != 0:
+        return None
+    return out
+
+
+def native_median(sel):
+    """Column-blocked native coordinate-wise median; (d,) f32 or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n, d = sel.shape
+    if n == 0 or d == 0:
+        return None
+    sel = np.ascontiguousarray(sel, np.float32)
+    out = np.empty(d, np.float32)
+    rc = lib.fl_median(sel, n, d, out)
     if rc != 0:
         return None
     return out

@@ -117,6 +117,42 @@ extern "C" int fl_trimmed_mean(
     return 0;
 }
 
+// Coordinate-wise median (defenses/median.py host path): the same
+// column-blocked gather as fl_trimmed_mean with just the median part —
+// NumPy semantics (mean of the two middles for even n, computed in f32).
+extern "C" int fl_median(
+    const float* sel,  // (n, d) row-major
+    int32_t n, int32_t d,
+    float* out         // (d,)
+) {
+    if (n <= 0 || d <= 0) return 1;
+    const int32_t BLOCK = 128;
+    std::vector<float> buf(static_cast<size_t>(n) * BLOCK);
+    std::vector<float> tmp(n);
+    for (int32_t c0 = 0; c0 < d; c0 += BLOCK) {
+        const int32_t bw = std::min(BLOCK, d - c0);
+        for (int64_t i = 0; i < n; ++i) {
+            const float* row = sel + i * static_cast<int64_t>(d) + c0;
+            for (int32_t c = 0; c < bw; ++c)
+                buf[static_cast<size_t>(c) * n + i] = row[c];
+        }
+        for (int32_t c = 0; c < bw; ++c) {
+            const float* col = buf.data() + static_cast<size_t>(c) * n;
+            std::copy(col, col + n, tmp.begin());
+            const int32_t h = n / 2;
+            std::nth_element(tmp.begin(), tmp.begin() + h, tmp.end());
+            float med = tmp[h];
+            if ((n & 1) == 0) {
+                const float lo =
+                    *std::max_element(tmp.begin(), tmp.begin() + h);
+                med = (lo + med) / 2.0f;
+            }
+            out[c0 + c] = med;
+        }
+    }
+    return 0;
+}
+
 extern "C" int fl_bulyan_select(
     const float* D,        // (n, n) row-major distances, +inf diagonal
     const int32_t* order,  // (n, n) per-row argsort (ascending) of D

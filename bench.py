@@ -433,6 +433,22 @@ def main():
                 s_b1 = time.perf_counter() - t0
                 recap(f"north-star: bulyan[q=1 exact, host native] @ "
                       f"{N_NORTH}: {s_b1:.1f} s")
+                t0 = time.perf_counter()
+                trimmed_mean(jnp.asarray(G10h), N_NORTH, f10,
+                             impl="host")
+                s_tmh = time.perf_counter() - t0
+                recap(f"north-star: trimmed_mean[host native] @ "
+                      f"{N_NORTH}: {s_tmh:.1f} s "
+                      f"(XLA:CPU measured 943.5 s, BASELINE.md)")
+                from attacking_federate_learning_tpu.defenses.median import (
+                    median as median_defense
+                )
+                t0 = time.perf_counter()
+                median_defense(jnp.asarray(G10h), N_NORTH, f10,
+                               impl="host")
+                s_mdh = time.perf_counter() - t0
+                recap(f"north-star: median[host native] @ {N_NORTH}: "
+                      f"{s_mdh:.1f} s")
                 del G10h
 
     # --- secondary: full FL round throughput (stderr diagnostic) --------

@@ -342,3 +342,45 @@ def test_trimmed_mean_host_impl_matches_xla():
     assert default_cfg.trimmed_mean_impl == "xla"
     with pytest.raises(ValueError):
         ExperimentConfig(trimmed_mean_impl="native")
+
+
+def test_median_host_impl_matches_xla():
+    """median_impl='host' mirrors the TrimmedMean opt-in: native kernel
+    parity with jnp.median, engine wiring, xla default."""
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+    from attacking_federate_learning_tpu.defenses.median import median
+
+    rng = np.random.default_rng(1)
+    for n in (7, 24):  # odd (middle element) and even (mean of mids)
+        G = jnp.asarray(rng.standard_normal((n, 4096)).astype(np.float32))
+        via_xla = np.asarray(median(G, n, 2))
+        via_host = np.asarray(median(G, n, 2, impl="host"))
+        np.testing.assert_allclose(via_host, via_xla, rtol=1e-6,
+                                   atol=1e-7)
+        via_host_jit = np.asarray(
+            jax.jit(lambda g, n=n: median(g, n, 2, impl="host"))(G))
+        np.testing.assert_allclose(via_host_jit, via_host, rtol=0, atol=0)
+
+    cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=8,
+                           mal_prop=0.25, batch_size=16, epochs=1,
+                           defense="Median", median_impl="host",
+                           synth_train=256, synth_test=64)
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=256, synth_test=64)
+    exp = FederatedExperiment(cfg, dataset=ds)
+    assert exp.defense_fn.keywords["impl"] == "host"
+    exp.run_span(0, 1)
+    assert np.isfinite(np.asarray(exp.state.weights)).all()
+    assert ExperimentConfig(defense="Median").median_impl == "xla"
+    with pytest.raises(ValueError):
+        ExperimentConfig(median_impl="blas")
+    # NaN inputs must fall back to np.median semantics (propagate NaN),
+    # never reach the native kernel (nth_element on NaN is UB).
+    Gn = np.ones((6, 8), np.float32)
+    Gn[2, 3] = np.nan
+    out = np.asarray(median(jnp.asarray(Gn), 6, 1, impl="host"))
+    assert np.isnan(out[3]) and np.isfinite(np.delete(out, 3)).all()
