@@ -95,13 +95,20 @@ def host_krum(G, users_count, corrupted_count, paper_scoring=False):
                              paper_scoring=paper_scoring)]
 
 
+def _all_finite(a: np.ndarray) -> bool:
+    """Full-finiteness check without materializing an (n, d) bool temp
+    (420 MB at the 10k north-star tail): two scalar reductions — NaN
+    propagates through min/max, ±inf is its own extremum."""
+    return bool(np.isfinite(a.min()) and np.isfinite(a.max()))
+
+
 def host_median(sel: np.ndarray):
     """Coordinate-wise median (defenses/median.py host path): the native
     column-blocked kernel when available AND the input is fully finite
     (std::nth_element on NaN is undefined behavior, and np.median's
     NaN-propagation must be preserved); np.median otherwise."""
     sel = np.asarray(sel, np.float32)
-    if sel.size and np.isfinite(sel).all():
+    if sel.size and _all_finite(sel):
         from attacking_federate_learning_tpu.native import native_median
         out = native_median(sel)
         if out is not None:
@@ -122,7 +129,7 @@ def host_trimmed_mean_of(sel: np.ndarray, number_to_consider: int):
     tests/test_defenses.py::test_host_trimmed_mean_partition_matches_stable_sort."""
     sel = np.asarray(sel, np.float32)
     k = int(number_to_consider)
-    if 0 < k <= sel.shape[0] and sel.size and np.isfinite(sel).all():
+    if 0 < k <= sel.shape[0] and sel.size and _all_finite(sel):
         from attacking_federate_learning_tpu.native import (
             native_trimmed_mean
         )

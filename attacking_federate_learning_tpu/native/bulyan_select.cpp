@@ -50,6 +50,36 @@
 #include <cstdint>
 #include <vector>
 
+// Shared column-blocked machinery for the coordinate-wise kernels: the
+// (n, d) matrix is row-major, so per-coordinate work would stride the
+// whole matrix; instead gather BLOCK columns at a time into an
+// L2-resident column-major buffer and run O(n) selection per column.
+static const int32_t kColBlock = 128;
+
+static void gather_block(const float* sel, int32_t n, int32_t d,
+                         int32_t c0, int32_t bw, float* buf) {
+    for (int64_t i = 0; i < n; ++i) {
+        const float* row = sel + i * static_cast<int64_t>(d) + c0;
+        for (int32_t c = 0; c < bw; ++c)
+            buf[static_cast<size_t>(c) * n + i] = row[c];
+    }
+}
+
+// NumPy median semantics: mid element (odd n) / f32 mean of the two
+// middles (even n).  Clobbers tmp.
+static float column_median(const float* col, int32_t n,
+                           std::vector<float>& tmp) {
+    std::copy(col, col + n, tmp.begin());
+    const int32_t h = n / 2;
+    std::nth_element(tmp.begin(), tmp.begin() + h, tmp.end());
+    float med = tmp[h];
+    if ((n & 1) == 0) {
+        const float lo = *std::max_element(tmp.begin(), tmp.begin() + h);
+        med = (lo + med) / 2.0f;
+    }
+    return med;
+}
+
 // Median-anchored trimmed mean (reference defences.py:48-51), evaluated
 // column-blocked so the per-coordinate work runs on L2-resident data —
 // the NumPy axis-0 formulation pays strided access over the whole
@@ -66,30 +96,14 @@ extern "C" int fl_trimmed_mean(
     float* out         // (d,)
 ) {
     if (n <= 0 || d <= 0 || k <= 0 || k > n) return 1;
-    const int32_t BLOCK = 128;
-    std::vector<float> buf(static_cast<size_t>(n) * BLOCK);
+    std::vector<float> buf(static_cast<size_t>(n) * kColBlock);
     std::vector<float> tmp(n), adev(n);
-    for (int32_t c0 = 0; c0 < d; c0 += BLOCK) {
-        const int32_t bw = std::min(BLOCK, d - c0);
-        // Gather: sequential reads over sel, strided writes into the
-        // small (L2-resident) column-major buffer.
-        for (int64_t i = 0; i < n; ++i) {
-            const float* row = sel + i * static_cast<int64_t>(d) + c0;
-            for (int32_t c = 0; c < bw; ++c)
-                buf[static_cast<size_t>(c) * n + i] = row[c];
-        }
+    for (int32_t c0 = 0; c0 < d; c0 += kColBlock) {
+        const int32_t bw = std::min(kColBlock, d - c0);
+        gather_block(sel, n, d, c0, bw, buf.data());
         for (int32_t c = 0; c < bw; ++c) {
             const float* col = buf.data() + static_cast<size_t>(c) * n;
-            // NumPy median: mid element (odd n) / mean of mids (even n).
-            std::copy(col, col + n, tmp.begin());
-            const int32_t h = n / 2;
-            std::nth_element(tmp.begin(), tmp.begin() + h, tmp.end());
-            float med = tmp[h];
-            if ((n & 1) == 0) {
-                const float lo =
-                    *std::max_element(tmp.begin(), tmp.begin() + h);
-                med = (lo + med) / 2.0f;  // f32, like np.median on f32
-            }
+            const float med = column_median(col, n, tmp);
             for (int32_t i = 0; i < n; ++i)
                 adev[i] = std::fabs(col[i] - med);
             std::copy(adev.begin(), adev.end(), tmp.begin());
@@ -117,38 +131,21 @@ extern "C" int fl_trimmed_mean(
     return 0;
 }
 
-// Coordinate-wise median (defenses/median.py host path): the same
-// column-blocked gather as fl_trimmed_mean with just the median part —
-// NumPy semantics (mean of the two middles for even n, computed in f32).
+// Coordinate-wise median (defenses/median.py host path).
 extern "C" int fl_median(
     const float* sel,  // (n, d) row-major
     int32_t n, int32_t d,
     float* out         // (d,)
 ) {
     if (n <= 0 || d <= 0) return 1;
-    const int32_t BLOCK = 128;
-    std::vector<float> buf(static_cast<size_t>(n) * BLOCK);
+    std::vector<float> buf(static_cast<size_t>(n) * kColBlock);
     std::vector<float> tmp(n);
-    for (int32_t c0 = 0; c0 < d; c0 += BLOCK) {
-        const int32_t bw = std::min(BLOCK, d - c0);
-        for (int64_t i = 0; i < n; ++i) {
-            const float* row = sel + i * static_cast<int64_t>(d) + c0;
-            for (int32_t c = 0; c < bw; ++c)
-                buf[static_cast<size_t>(c) * n + i] = row[c];
-        }
-        for (int32_t c = 0; c < bw; ++c) {
-            const float* col = buf.data() + static_cast<size_t>(c) * n;
-            std::copy(col, col + n, tmp.begin());
-            const int32_t h = n / 2;
-            std::nth_element(tmp.begin(), tmp.begin() + h, tmp.end());
-            float med = tmp[h];
-            if ((n & 1) == 0) {
-                const float lo =
-                    *std::max_element(tmp.begin(), tmp.begin() + h);
-                med = (lo + med) / 2.0f;
-            }
-            out[c0 + c] = med;
-        }
+    for (int32_t c0 = 0; c0 < d; c0 += kColBlock) {
+        const int32_t bw = std::min(kColBlock, d - c0);
+        gather_block(sel, n, d, c0, bw, buf.data());
+        for (int32_t c = 0; c < bw; ++c)
+            out[c0 + c] = column_median(
+                buf.data() + static_cast<size_t>(c) * n, n, tmp);
     }
     return 0;
 }
