@@ -39,11 +39,19 @@
 //     gaps exceed the f32 summation's rounding error (a few ulps,
 //     ~log2(n) worst case); within that noise band either pick is
 //     inside the reference's own numerical indeterminacy (its torch
-//     f32 sums have the same-order error with yet another ordering),
-//     and in a 1,000-trial randomized sweep incl. 1e6-magnitude
-//     adversarial rows the selected set and aggregate never diverged.
+//     f32 sums have the same-order error with yet another ordering).
+//     Measured (tests/test_native.py::test_adversarial_tie_randomized_
+//     sweep, checked in): 3/1000 adversarial 1e6-magnitude trials
+//     diverge at set level, every one a <=1-ulp f32 tie at its first
+//     diverging trip; the sweep asserts that bound.
 //
 // Built on demand by attacking_federate_learning_tpu/native/__init__.py.
+//
+// Error contract: every kernel returns nonzero on ANY failure — including
+// std::bad_alloc from the O(n^2) scratch (~16 bytes/entry, ~1.7 GB at
+// n=10,240).  An exception escaping the extern "C" boundary into the
+// ctypes frame would std::terminate the whole process; catching it keeps
+// the documented degrade-to-NumPy fallback reachable.
 
 #include <algorithm>
 #include <cmath>
@@ -90,7 +98,7 @@ static float column_median(const float* col, int32_t n,
 //   - keep the k smallest |dev| with boundary ties resolved to the
 //     LOWEST row index (Python's stable sorted());
 //   - mean of kept deviations + median, accumulated in f64.
-extern "C" int fl_trimmed_mean(
+static int trimmed_mean_impl(
     const float* sel,  // (n, d) row-major
     int32_t n, int32_t d, int32_t k,
     float* out         // (d,)
@@ -132,7 +140,7 @@ extern "C" int fl_trimmed_mean(
 }
 
 // Coordinate-wise median (defenses/median.py host path).
-extern "C" int fl_median(
+static int median_impl(
     const float* sel,  // (n, d) row-major
     int32_t n, int32_t d,
     float* out         // (d,)
@@ -150,7 +158,7 @@ extern "C" int fl_median(
     return 0;
 }
 
-extern "C" int fl_bulyan_select(
+static int bulyan_select_impl(
     const float* D,        // (n, n) row-major distances, +inf diagonal
     const int32_t* order,  // (n, n) per-row argsort (ascending) of D
     int32_t n,
@@ -279,4 +287,36 @@ extern "C" int fl_bulyan_select(
         }
     }
     return 0;
+}
+
+// extern "C" surface (see error contract at the top of the file).
+extern "C" int fl_trimmed_mean(const float* sel, int32_t n, int32_t d,
+                               int32_t k, float* out) {
+    try {
+        return trimmed_mean_impl(sel, n, d, k, out);
+    } catch (...) {
+        return 1;
+    }
+}
+
+extern "C" int fl_median(const float* sel, int32_t n, int32_t d,
+                         float* out) {
+    try {
+        return median_impl(sel, n, d, out);
+    } catch (...) {
+        return 1;
+    }
+}
+
+extern "C" int fl_bulyan_select(const float* D, const int32_t* order,
+                                int32_t n, int32_t users_count, int32_t f,
+                                int32_t set_size, int32_t q,
+                                int32_t paper_scoring,
+                                int32_t* out_selected) {
+    try {
+        return bulyan_select_impl(D, order, n, users_count, f, set_size,
+                                  q, paper_scoring, out_selected);
+    } catch (...) {
+        return 1;
+    }
 }
