@@ -13,10 +13,13 @@ adversarial 1e6-scale rows compress relative gaps under f32 eps), the
 NumPy path's f32 pairwise sums land on arbitrary orders the
 f32-quantized-f64 native comparator cannot always reproduce bit-for-bit
 — the reference's own torch f32 sums would give yet another order, so
-within that noise band no ordering is canonical.  The selected *set* and
-the final aggregate still matched everywhere in a 1,000-trial randomized
-sweep at build time; the adversarial near-tie case is asserted at
-set/aggregate level here.
+within that noise band no ordering is canonical.  The checked-in
+1,000-trial sweep (test_adversarial_tie_randomized_sweep) measures the
+contract precisely: 3/1000 adversarial trials diverge at set level,
+every one a <=1-ulp f32 tie at its first diverging trip — and the sweep
+asserts that any divergence stays inside that tie band (a swapped
+tie-row can shift the trimmed mean by that row's contribution, which is
+inside the reference's own f32 indeterminacy).
 """
 
 from __future__ import annotations
@@ -118,6 +121,88 @@ class TestNativeBulyanSelection:
         monkeypatch.setattr(nat_mod, "_loaded", True)
         via_numpy = host_bulyan(G, 14, 2, batch_select=2)
         np.testing.assert_allclose(via_native, via_numpy, atol=1e-6)
+
+    def test_adversarial_tie_randomized_sweep(self):
+        # The checked-in 1,000-trial randomized sweep (VERDICT r3 weak
+        # #2), asserting the PRECISE tie-band contract documented at
+        # native/bulyan_select.cpp: under 1e6-magnitude adversarial rows
+        # the native and NumPy selections are set-equal (and the trimmed
+        # means allclose) on every trial whose decisive f32 score gaps
+        # exceed summation noise, and any set divergence must be an
+        # f32 ulp-level tie at its first diverging trip — a pick the
+        # reference's own f32 summation order cannot canonicalize either.
+        # Writing this sweep down found what the round-3 session sweep
+        # missed: 3/1000 trials DO diverge at set level, every one a
+        # <=1-ulp tie (the r3 "set never diverged" claim was too strong;
+        # BASELINE.md/PARITY.md now state the measured contract).
+        rng = np.random.default_rng(0xB1A5)
+        divergences = []
+        for trial in range(1000):
+            n = int(rng.integers(6, 28))
+            f = int(rng.integers(0, max(1, (n - 1) // 4)))
+            q = int(rng.integers(1, 4))
+            G = rng.standard_normal((n, 6)).astype(np.float32)
+            G[0] *= 1e6                       # adversarial magnitude
+            if trial % 3 == 0:
+                G[1] = G[2]                   # duplicate rows
+            if trial % 7 == 0:
+                with np.errstate(over="ignore", invalid="ignore"):
+                    G[3] *= 1e25              # f32 overflow -> inf dists
+            with np.errstate(over="ignore", invalid="ignore"):
+                nat, ref, set_size = _both(G, n, f, q=q)
+            assert nat is not None
+            if set(nat.tolist()) == set(ref.tolist()):
+                keep = set_size - 2 * f - 1
+                if keep > 0:
+                    np.testing.assert_allclose(
+                        host_trimmed_mean_of(G[nat], keep),
+                        host_trimmed_mean_of(G[ref], keep),
+                        rtol=1e-5, atol=1e-5,
+                        err_msg=f"trial {trial} (n={n}, f={f}, q={q})")
+                continue
+            with np.errstate(over="ignore", invalid="ignore"):
+                gap = self._ulp_gap_at_divergence(G, n, f, q, nat, ref)
+            assert gap is not None and gap <= 2.0, (
+                f"trial {trial}: set diverged OUTSIDE the f32 tie band "
+                f"(n={n}, f={f}, q={q}, gap={gap} ulps)")
+            divergences.append((trial, gap))
+        # The divergence rate itself is part of the pinned contract: a
+        # native-comparator regression that starts resolving real gaps
+        # differently would blow well past this bound.
+        assert len(divergences) <= 10, divergences
+
+    @staticmethod
+    def _ulp_gap_at_divergence(G, n, f, q, nat, ref):
+        """Replay the NumPy scoring to the first diverging trip; return
+        the two picks' f32 score gap in ulps at that magnitude (0.0 for
+        non-finite ties, None if the selections never diverge)."""
+        from attacking_federate_learning_tpu.defenses.host import (
+            _prefix_scores
+        )
+        D = host_pairwise_distances(np.asarray(G, np.float32))
+        order = np.argsort(D, axis=1).astype(np.int32)
+        sortedD = np.take_along_axis(D, order, axis=1)
+        finite = np.isfinite(sortedD)
+        alive = np.ones(n, bool)
+        s, set_size = 0, len(ref)
+        while s < set_size:
+            r = min(q, set_size - s)
+            scores = _prefix_scores(sortedD, order, finite, alive,
+                                    n - s, f)
+            t_nat = set(nat[s:s + r].tolist())
+            t_ref = set(ref[s:s + r].tolist())
+            if t_nat != t_ref:
+                vals = [scores[i] for i in t_nat ^ t_ref]
+                lo, hi = min(vals), max(vals)
+                if not np.isfinite(lo):
+                    return 0.0
+                ulp = float(np.spacing(np.float32(max(abs(lo),
+                                                      abs(hi)))))
+                return float(hi - lo) / ulp
+            idxs = np.argsort(scores, kind="stable")[:r]
+            alive[idxs] = False
+            s += r
+        return None
 
     def test_degenerate_shapes(self):
         # f=0 (select everyone), n=4 minimum, q larger than set_size.
