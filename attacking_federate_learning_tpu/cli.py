@@ -53,6 +53,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default=ExperimentConfig.dnc_filter_frac, type=float,
                    help="DnC outliers removed per iteration, as a "
                         "fraction of f")
+    p.add_argument("--geomed-iters", default=ExperimentConfig.geomed_iters,
+                   type=int, help="GeoMedian Weiszfeld iterations")
+    p.add_argument("--geomed-eps", default=ExperimentConfig.geomed_eps,
+                   type=float,
+                   help="GeoMedian distance-smoothing floor")
     p.add_argument("--trimmed-mean-impl",
                    default=ExperimentConfig.trimmed_mean_impl,
                    choices=["xla", "host"],
@@ -154,6 +159,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "scores (a flagged relaxation of the reference's "
                         "sequential selection for the 10k regime); 1 = "
                         "reference-exact")
+    p.add_argument("--bulyan-selection-impl",
+                   default=ExperimentConfig.bulyan_selection_impl,
+                   choices=["xla", "host"],
+                   help="Bulyan selection engine: traced XLA loop "
+                        "(default) or the hybrid exact path — device "
+                        "distances, one (n, n) host marshal, native "
+                        "incremental selection, device trim-mean "
+                        "(the exact-semantics 10k accelerator route)")
     p.add_argument("--distance-impl", default="auto",
                    choices=["auto", "xla", "pallas", "host", "ring",
                             "allgather"],
@@ -235,6 +248,7 @@ def config_from_args(args) -> ExperimentConfig:
         distance_impl=args.distance_impl,
         distance_dtype=args.distance_dtype,
         bulyan_batch_select=args.bulyan_batch_select,
+        bulyan_selection_impl=args.bulyan_selection_impl,
         server_uses_faded_lr=args.server_uses_faded_lr,
         log_round_stats=args.round_stats,
         synth_train=args.synth_train,
@@ -245,6 +259,8 @@ def config_from_args(args) -> ExperimentConfig:
         dnc_iters=args.dnc_iters,
         dnc_sketch_dim=args.dnc_sketch_dim,
         dnc_filter_frac=args.dnc_filter_frac,
+        geomed_iters=args.geomed_iters,
+        geomed_eps=args.geomed_eps,
         trimmed_mean_impl=args.trimmed_mean_impl,
         median_impl=args.median_impl,
     )

@@ -180,6 +180,17 @@ class ExperimentConfig:
     # trips (ceil(set_size/q) trips instead of set_size).  1 = the
     # reference's exact semantics (the default, like every quirk flag).
     bulyan_batch_select: int = 1
+    # Bulyan selection engine (defenses/kernels.py:bulyan): 'xla' (the
+    # traced fixed-trip loop — reference-exact, compiles into the fused
+    # round program) or 'host' — the HYBRID exact path for the
+    # accelerator at large n: distances stay on the MXU, the (n, n) D
+    # ships to the host once for the native O(n^2) incremental
+    # selection, and the gather + trimmed mean run back on the device.
+    # Opt-in (not auto): host ties resolve by the native comparator
+    # (ulp-band only — tests/test_native.py), and the pure_callback
+    # marshal is only worth it when set_size sequential XLA trips cost
+    # more than one D transfer (the 10k north-star regime).
+    bulyan_selection_impl: str = "xla"
     # Attack statistics over the malicious cohort only (reference
     # malicious.py:14-19), matching the ALIE threat model.
 
@@ -196,6 +207,10 @@ class ExperimentConfig:
     dnc_iters: int = 5
     dnc_sketch_dim: int = 2048
     dnc_filter_frac: float = 1.5
+    # GeoMedian smoothed-Weiszfeld constants (defenses/geomed.py) — same
+    # config-surface standard as the DnC knobs above.
+    geomed_iters: int = 10
+    geomed_eps: float = 1e-6
     # Coordinate-wise kernels: 'xla' (default — keeps staged/fused
     # rounds on the same kernel, preserving bit-identity) or 'host'
     # (opt-in: the native column-blocked kernels, ~minutes -> ~25 s at
@@ -247,6 +262,10 @@ class ExperimentConfig:
             raise ValueError(
                 f"bulyan_batch_select must be >= 1, got "
                 f"{self.bulyan_batch_select}")
+        if self.bulyan_selection_impl not in ("xla", "host"):
+            raise ValueError(
+                f"bulyan_selection_impl must be 'xla' or 'host', "
+                f"got {self.bulyan_selection_impl!r}")
         if self.attack_direction not in ("std", "sign", "unit"):
             raise ValueError(
                 f"attack_direction must be 'std', 'sign' or 'unit', "
@@ -258,6 +277,10 @@ class ExperimentConfig:
         if self.dnc_filter_frac <= 0:
             raise ValueError(
                 f"dnc_filter_frac must be > 0, got {self.dnc_filter_frac}")
+        if self.geomed_iters < 1 or self.geomed_eps <= 0:
+            raise ValueError(
+                f"geomed_iters must be >= 1 and geomed_eps > 0, got "
+                f"{self.geomed_iters}/{self.geomed_eps}")
         if self.trimmed_mean_impl not in ("xla", "host"):
             raise ValueError(
                 f"trimmed_mean_impl must be 'xla' or 'host', "

@@ -113,6 +113,11 @@ class FederatedExperiment:
                 sketch_dim=cfg.dnc_sketch_dim,
                 filter_frac=cfg.dnc_filter_frac, seed=cfg.seed)
             self.defense_fn.needs_round = True  # partial drops attributes
+        elif cfg.defense == "GeoMedian":
+            # Weiszfeld constants are config surface like the DnC knobs.
+            self.defense_fn = functools.partial(
+                self.defense_fn, iters=cfg.geomed_iters,
+                eps=cfg.geomed_eps)
 
         key = jax.random.key(cfg.seed)
         k_init, self.key_run = jax.random.split(key)
@@ -203,10 +208,13 @@ class FederatedExperiment:
             kw["paper_scoring"] = True
         if cfg.distance_dtype != "float32":
             kw["distance_dtype"] = cfg.distance_dtype
-        bulyan_kw = ({"batch_select": cfg.bulyan_batch_select}
-                     if (cfg.defense == "Bulyan"
-                         and cfg.bulyan_batch_select != 1) else {})
-        kw.update(bulyan_kw)
+        if cfg.defense == "Bulyan":
+            if cfg.bulyan_batch_select != 1:
+                kw["batch_select"] = cfg.bulyan_batch_select
+            if cfg.bulyan_selection_impl != "xla":
+                # Hybrid exact selection: device distances, one (n, n)
+                # D marshal, native host selection, device trim-mean.
+                kw["selection_impl"] = cfg.bulyan_selection_impl
         impl = cfg.distance_impl
         if impl in ("ring", "allgather"):
             if self.shardings is None:

@@ -324,10 +324,43 @@ def host_coordwise(host_fn, users_grads):
                              users_grads.astype(jnp.float32))
 
 
+def _host_bulyan_selection_of(D, users_count, corrupted_count, set_size,
+                              batch_select, paper_scoring):
+    """Host-side exact selection over a DEVICE-computed distance matrix —
+    the hybrid's host half (VERDICT r3 #2).  ``pure_callback`` under
+    trace (marshals the (n, n) D — ~420 MB at n=10,240, the hybrid's one
+    data motion), zero-copy eager otherwise; returns (set_size,) int32
+    selected indices.  The native incremental engine
+    (native/bulyan_select.cpp) makes the selection itself O(n^2) total;
+    D must already carry the +inf diagonal."""
+    import numpy as np
+
+    from attacking_federate_learning_tpu.defenses.host import (
+        host_bulyan_selection
+    )
+
+    n_static = int(users_count)
+    f_static = int(corrupted_count)
+    k_static = int(set_size)
+    q_static = int(batch_select)
+
+    def cb(Dh):
+        return host_bulyan_selection(
+            np.asarray(Dh, np.float32), n_static, f_static, k_static,
+            batch_select=q_static,
+            paper_scoring=paper_scoring).astype(np.int32)
+
+    if not isinstance(D, jax.core.Tracer):
+        return jnp.asarray(cb(D))
+    return jax.pure_callback(cb,
+                             jax.ShapeDtypeStruct((k_static,), jnp.int32),
+                             D.astype(jnp.float32))
+
+
 @DEFENSES.register("Bulyan")
 def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
            method="sort", distance_impl="xla", D=None, batch_select=1,
-           distance_dtype=None):
+           distance_dtype=None, selection_impl="xla"):
     """Bulyan (reference defences.py:55-70): iteratively Krum-select
     n - 2f gradients (removing each winner from the pool, with the pool
     size — but not f — shrinking), then trim-mean the selection with
@@ -355,13 +388,32 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
     no longer needs the relaxation at scale: the native incremental
     kernel (native/bulyan_select.cpp) maintains every row's prefix score
     in O(1) amortized per selection, making the whole exact selection
-    O(n^2) total instead of O(n^2) per step."""
+    O(n^2) total instead of O(n^2) per step.
+
+    ``selection_impl='host'`` is the HYBRID exact path for the
+    accelerator backend at large n (VERDICT r3 #2): the O(n^2 d)
+    distance work stays on the device (MXU Gram via ``distance_impl``),
+    only the (n, n) D ships to the host — once — for the native O(n^2)
+    incremental selection, and the selected rows are gathered and
+    trim-meaned back on the device.  That replaces the traced path's
+    set_size sequential O(n^2) scoring trips (~5,300 dependent
+    (10240, 10240) passes per aggregation at the north star) with one
+    D transfer + seconds of host selection, while keeping exact q=1
+    reference semantics.  Composes with ``batch_select`` and the
+    ``D=`` seam; opt-in (config ``bulyan_selection_impl``), not
+    auto-dispatched, because host selection resolves f32 score ties by
+    the native engine's comparator (see native/bulyan_select.cpp) while
+    the traced loop uses f32 throughout — identical outside ulp-band
+    ties (tests/test_defenses.py pins hybrid==xla on plain inputs)."""
     n, _ = users_grads.shape
     f = corrupted_count
     set_size = users_count - 2 * f
     q = int(batch_select)
     if not (1 <= q):
         raise ValueError(f"batch_select must be >= 1, got {batch_select}")
+    if selection_impl not in ("xla", "host"):
+        raise ValueError(f"selection_impl must be 'xla' or 'host', "
+                         f"got {selection_impl!r}")
     q = min(q, set_size)
     if D is None:
         impl = resolve_distance_impl(distance_impl, users_count,
@@ -377,9 +429,19 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
                                  corrupted_count, paper_scoring)
         D = _distances_for(users_grads, impl, distance_dtype)
 
-    # Presort once: +inf diagonal reproduces the reference's no-self-
-    # distance dict (defences.py:16-21).
+    # +inf diagonal reproduces the reference's no-self-distance dict
+    # (defences.py:16-21).
     Dm = D + jnp.diag(jnp.full((n,), _INF, D.dtype))
+
+    if selection_impl == "host":
+        # Hybrid: device distances above, host-native exact selection,
+        # device gather + trimmed mean below.
+        selected = _host_bulyan_selection_of(
+            Dm, users_count, corrupted_count, set_size, q, paper_scoring)
+        selection = users_grads[selected]
+        return trimmed_mean_of(selection, set_size - 2 * f - 1)
+
+    # Presort once for the traced selection loop.
     order = jnp.argsort(Dm, axis=1)
     sortedD = jnp.take_along_axis(Dm, order, axis=1)
     finite = jnp.isfinite(sortedD)

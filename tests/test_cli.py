@@ -108,6 +108,15 @@ def test_distance_impl_and_scoring_flags(tmp_path):
     assert result["accuracies"][-1] > 0.0
 
 
+def test_geomed_flags(tmp_path):
+    _, result = run_cli(tmp_path, ["-n", "8", "-m", "0.25",
+                                   "-d", "GeoMedian",
+                                   "--geomed-iters", "3",
+                                   "--geomed-eps", "1e-4"],
+                        epochs=2)
+    assert result["accuracies"][-1] > 0.0
+
+
 def test_augment_flag_parses(tmp_path):
     _, result = run_cli(tmp_path, ["-n", "4", "-m", "0.0",
                                    "--augment", "off"], epochs=2)

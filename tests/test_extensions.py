@@ -262,6 +262,42 @@ def test_dnc_config_knobs_reach_the_kernel():
         EC(dnc_filter_frac=0.0)
 
 
+def test_geomed_config_knobs_reach_the_kernel():
+    """geomed_iters/geomed_eps are config surface wired through the
+    registry partial (VERDICT r3 #7 — the DnC config-surface standard)."""
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+
+    def agg_for(**knobs):
+        cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=8,
+                               mal_prop=0.25, batch_size=16, epochs=1,
+                               defense="GeoMedian", synth_train=256,
+                               synth_test=64, **knobs)
+        ds = load_dataset(cfg.dataset, seed=0, synth_train=256,
+                          synth_test=64)
+        exp = FederatedExperiment(cfg, dataset=ds)
+        assert exp.defense_fn.keywords["iters"] == cfg.geomed_iters
+        assert exp.defense_fn.keywords["eps"] == cfg.geomed_eps
+        rng = np.random.default_rng(3)
+        G = jnp.asarray(rng.standard_normal((8, 64)).astype(np.float32))
+        G = G.at[0].set(1e4)   # outlier so iteration count matters
+        return np.asarray(exp.defense_fn(G, 8, 2))
+
+    base = agg_for()
+    np.testing.assert_array_equal(base, agg_for())
+    # One Weiszfeld step from the mean is still outlier-dragged; the
+    # default 10 steps must land measurably closer to the honest mass.
+    assert not np.allclose(base, agg_for(geomed_iters=1))
+    # eps large enough to flatten the weights degenerates toward the mean.
+    assert not np.allclose(base, agg_for(geomed_eps=1e6))
+    with pytest.raises(ValueError):
+        ExperimentConfig(defense="GeoMedian", geomed_iters=0)
+
+
 def test_attack_direction_is_reachable():
     """--attack-direction reaches MinMax/MinSum (advisor: previously dead
     surface)."""
