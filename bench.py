@@ -26,6 +26,15 @@ then fetches one element of the LAST output to host — the single device
 stream executes in dispatch order, so the fetch bounds all K — and
 subtracts a separately-measured fetch round-trip.
 
+Validity gate (round 4): the emitted JSON carries ``valid`` —
+True only when every check passed; poisoned (with ``invalid_reasons``)
+when a timed wall falls below the measured fetch RTT, when any implied
+throughput exceeds the bf16 physical peak (both signatures of the
+round-3 first-contact failure, where ``block_until_ready`` lied through
+the relay), or when two f32 distance engines disagree on the Krum
+selection index on-chip.  A garbage number can no longer be recorded as
+a headline.
+
 Hang protection is layered, because no single mechanism covers a relay
 that dies mid-run (the round-2 failure mode): (a) each phase runs under
 a SIGALRM bound — interrupts Python-level waits; (b) relay liveness is
@@ -57,9 +66,15 @@ HOST_FLOOR_10K_MS = 72_700.0  # measured host-BLAS floor @ 10,240 (BASELINE.md)
 # Peak f32-accumulation matmul throughput used for the MFU estimate.
 # TPU v5e: 197 TFLOP/s bf16, ~98 TFLOP/s f32 (public spec sheet numbers).
 PEAK_FLOPS = {"tpu": 98e12, "axon": 98e12}
+# Validity ceiling: NOTHING can beat the bf16 systolic peak.  A timed
+# kernel whose implied throughput exceeds this is a broken measurement
+# (the round-3 first-contact failure printed "7742% of peak" as a plain
+# diagnostic; this gate makes that impossible to record as valid).
+PEAK_BF16 = {"tpu": 197e12, "axon": 197e12}
 
 RECAP: list[str] = []
 RESULT: dict = {}   # headline snapshot for the final-deadline escape hatch
+_EMITTED = False    # once-guard: main() + the deadline timer both emit
 
 
 def log(msg):
@@ -72,8 +87,19 @@ def recap(msg):
 
 
 def emit_result_json():
-    if RESULT:
+    global _EMITTED
+    if RESULT and not _EMITTED:
+        _EMITTED = True
         print(json.dumps(RESULT), flush=True)
+
+
+def mark_invalid(reason):
+    """Poison the emitted JSON's validity and say why, loudly."""
+    RESULT["valid"] = False
+    reasons = RESULT.setdefault("invalid_reasons", [])
+    if reason not in reasons:
+        reasons.append(reason)
+    recap(f"  !! VALIDITY: {reason}")
 
 
 def arm_final_deadline(seconds):
@@ -185,13 +211,16 @@ def timed_ms(make_out, iters=6, loops=3, rtt=0.0):
     """Median over ``loops`` of: dispatch ``iters`` back-to-back
     executions, fetch one element of the last output (in-order device
     stream => bounds all of them), minus fetch RTT, per iteration.
-    Returns ``(ms, last_fetched_value)`` so callers that need an output
-    element (e.g. a selection index) don't pay an extra execution.
-    Clamped at 0.05 ms: on a jittery link the one-shot RTT estimate can
-    exceed a fast kernel's wall time, and a <=0 result would poison the
-    vs_baseline division downstream."""
+    Returns ``(ms, last_fetched_value, ok)``: the value so callers that
+    need an output element (e.g. a selection index) don't pay an extra
+    execution, and ``ok=False`` when the timing is untrustworthy — the
+    raw wall fell below the measured fetch RTT (physically impossible
+    for a real execution: the final fetch alone costs one RTT) or the
+    RTT correction dominated the wall.  Clamped at 0.05 ms so a <=0
+    result can't poison the vs_baseline division downstream."""
     val = fetch1(make_out())        # compile + warm
     ts = []
+    ok = True
     for _ in range(loops):
         t0 = time.perf_counter()
         for _ in range(iters - 1):
@@ -199,26 +228,37 @@ def timed_ms(make_out, iters=6, loops=3, rtt=0.0):
         out = make_out()
         val = fetch1(out)
         wall = 1e3 * (time.perf_counter() - t0)
-        if rtt > 0.5 * wall:
-            log(f"  (rtt correction {rtt:.1f} ms dominates wall "
-                f"{wall:.1f} ms — timing unreliable at this size)")
+        if wall < rtt or rtt > 0.5 * wall:
+            log(f"  (rtt {rtt:.1f} ms vs wall {wall:.1f} ms — timing "
+                f"unreliable at this size)")
+            ok = False
         ts.append(max((wall - rtt) / iters, 0.05))
-    return float(np.median(ts)), val
+    return float(np.median(ts)), val, ok
 
 
-def device_krum_ms(G, f, krum_fn, iters=6, rtt=0.0) -> float:
-    ms, _ = timed_ms(lambda: krum_fn(G, G.shape[0], f), iters=iters,
-                     rtt=rtt)
-    return ms
+def device_krum_ms(G, f, krum_fn, iters=6, rtt=0.0):
+    ms, _, ok = timed_ms(lambda: krum_fn(G, G.shape[0], f), iters=iters,
+                         rtt=rtt)
+    return ms, ok
 
 
 def mfu_line(tag, flops, ms, platform, to_recap=False):
+    """Log the implied throughput; returns the achieved fraction of the
+    bf16 physical ceiling (None off-accelerator) so callers can gate
+    validity — a fraction > 1.0 means the measurement is broken, never
+    that the kernel is fast."""
     peak = PEAK_FLOPS.get(platform)
-    if peak and ms > 0:
-        achieved = flops / (ms * 1e-3)
-        line = (f"  mfu[{tag}]: {achieved / 1e12:.1f} TFLOP/s = "
-                f"{100 * achieved / peak:.1f}% of f32 peak")
-        (recap if to_recap else log)(line)
+    if not peak or ms <= 0:
+        return None
+    achieved = flops / (ms * 1e-3)
+    line = (f"  mfu[{tag}]: {achieved / 1e12:.1f} TFLOP/s = "
+            f"{100 * achieved / peak:.1f}% of f32 peak")
+    (recap if to_recap else log)(line)
+    frac_ceiling = achieved / PEAK_BF16.get(platform, peak)
+    if frac_ceiling > 1.0:
+        mark_invalid(f"mfu[{tag}] implies {achieved / 1e12:.0f} TFLOP/s "
+                     f"> bf16 physical peak — measurement broken")
+    return frac_ceiling
 
 
 def bench_impl_table(G, f, on_accel, rtt=0.0, iters=4):
@@ -252,13 +292,13 @@ def bench_impl_table(G, f, on_accel, rtt=0.0, iters=4):
                     static_argnums=(1, 2))
             # krum_select returns the index itself, so the timed loop's
             # final fetch already holds it — no extra execution.
-            ms, val = timed_ms(lambda: sel_fn(G, n, f), iters=iters,
-                               rtt=rtt)
+            ms, val, ok = timed_ms(lambda: sel_fn(G, n, f), iters=iters,
+                                   rtt=rtt)
             idx = int(val)
             rows[label] = ms
             idxs[label] = idx
             recap(f"  krum impl={label:13s} n={n}: {ms:10.2f} ms  "
-                  f"(select={idx})")
+                  f"(select={idx}){'' if ok else '  [TIMING INVALID]'}")
         except Exception as e:
             recap(f"  krum impl={label:13s} n={n}: failed "
                   f"({type(e).__name__}: {e})")
@@ -272,6 +312,14 @@ def bench_impl_table(G, f, on_accel, rtt=0.0, iters=4):
                                  if "bf16" in k})):
         if len(group) > 1 and len(set(group.values())) > 1:
             recap(f"  !! {tag} impl DISAGREEMENT at n={n}: {group}")
+            if tag == "f32":
+                # f32 engines computing the same math MUST agree; a flip
+                # means on-chip correctness is unproven, so no per-impl
+                # number (nor the headline that shares the xla engine)
+                # may be quoted as valid.  (bf16 flips on near-tied
+                # scores are legitimate — tests/test_distance_impl.py.)
+                mark_invalid(f"f32 distance impls disagree on the Krum "
+                             f"index at n={n}")
         elif len(group) > 1:
             recap(f"  {tag} impls agree at n={n} "
                   f"(select={next(iter(group.values()))})")
@@ -296,7 +344,7 @@ def main():
 
     dev = jax.devices()[0]
     on_accel = dev.platform not in ("cpu",)
-    arm_final_deadline(5100 if on_accel else 1800)
+    deadline_timer = arm_final_deadline(5100 if on_accel else 1800)
     n = N_CLIENTS if on_accel else 512  # keep the CPU fallback tractable
     f = int(F_FRAC * n)
     recap(f"device: {dev.platform} ({dev.device_kind}); n={n} d={DIM} f={f}")
@@ -332,12 +380,17 @@ def main():
 
     dev_ms = None
     with phase("headline", 420):
-        dev_ms = device_krum_ms(G, f, krum_fn, rtt=rtt)
+        dev_ms, time_ok = device_krum_ms(G, f, krum_fn, rtt=rtt)
         impl = "xla/jit" if on_accel else "host-blas (auto)"
         recap(f"framework krum [{impl}] ({dev.platform}): {dev_ms:.2f} ms")
+        # valid starts True and every gate can only poison it: RTT-floor
+        # (time_ok), MFU <= bf16 physical peak (mfu_line), f32 impl
+        # agreement (bench_impl_table below).
         RESULT.update(
             metric=f"krum_agg_{n}c_wall_ms", value=round(dev_ms, 3),
-            unit="ms", vs_baseline=round(cpu_ms / dev_ms, 2))
+            unit="ms", vs_baseline=round(cpu_ms / dev_ms, 2), valid=True)
+        if not time_ok:
+            mark_invalid("headline wall fell below the measured fetch RTT")
         # Gram matmul dominates: 2 n^2 d FLOPs.
         mfu_line("krum_gram", 2 * n * n * DIM, dev_ms, dev.platform,
                  to_recap=True)
@@ -371,12 +424,13 @@ def main():
         with phase("north-star-krum", 600):
             if G10 is None:
                 raise RuntimeError("G10 unavailable (creation failed)")
-            ms10 = device_krum_ms(
+            ms10, ok10 = device_krum_ms(
                 G10, f10, jax.jit(krum, static_argnums=(1, 2)),
                 iters=3, rtt=rtt)
             recap(f"north-star: krum @ {N_NORTH} clients, d={DIM}: "
                   f"{ms10:.1f} ms (host-BLAS floor {HOST_FLOOR_10K_MS:.0f} ms"
-                  f" => {HOST_FLOOR_10K_MS / ms10:.0f}x)")
+                  f" => {HOST_FLOOR_10K_MS / ms10:.0f}x)"
+                  f"{'' if ok10 else '  [TIMING INVALID]'}")
             mfu_line("krum_gram_10k", 2 * N_NORTH * N_NORTH * DIM, ms10,
                      dev.platform, to_recap=True)
             log("per-impl table @ 10k:")
@@ -386,9 +440,27 @@ def main():
             if G10 is None:
                 raise RuntimeError("G10 unavailable (creation failed)")
             tm_fn = jax.jit(trimmed_mean, static_argnums=(1, 2))
-            ms_tm, _ = timed_ms(lambda: tm_fn(G10, N_NORTH, f10),
-                                iters=2, rtt=rtt)
-            recap(f"north-star: trimmed_mean @ {N_NORTH}: {ms_tm:.1f} ms")
+            ms_tm, _, ok_tm = timed_ms(lambda: tm_fn(G10, N_NORTH, f10),
+                                       iters=2, rtt=rtt)
+            recap(f"north-star: trimmed_mean @ {N_NORTH}: {ms_tm:.1f} ms"
+                  f"{'' if ok_tm else '  [TIMING INVALID]'}")
+        with phase("north-star-bulyan-hybrid", 600):
+            # VERDICT r3 #2: the exact-semantics accelerator path at
+            # 10k — device Gram on the MXU, ONE (n, n) D marshal
+            # (~420 MB) to the native host selection engine, device
+            # gather + trim-mean.  Runs before the traced-exact phase:
+            # this is the number the design argument needs most.
+            gate()
+            if G10 is None:
+                raise RuntimeError("G10 unavailable (creation failed)")
+            hy_fn = jax.jit(
+                functools.partial(bulyan, selection_impl="host"),
+                static_argnums=(1, 2))
+            ms_hy, _, ok_hy = timed_ms(lambda: hy_fn(G10, N_NORTH, f10),
+                                       iters=1, loops=2, rtt=rtt)
+            recap(f"north-star: bulyan[exact, hybrid] @ {N_NORTH}: "
+                  f"{ms_hy:.1f} ms (incl. the one (n,n) D marshal)"
+                  f"{'' if ok_hy else '  [TIMING INVALID]'}")
         with phase("north-star-bulyan-batched", 420):
             gate()
             if G10 is None:
@@ -396,17 +468,19 @@ def main():
             bq_fn = jax.jit(
                 functools.partial(bulyan, batch_select=64),
                 static_argnums=(1, 2))
-            ms_bq, _ = timed_ms(lambda: bq_fn(G10, N_NORTH, f10),
-                                iters=1, loops=2, rtt=rtt)
-            recap(f"north-star: bulyan[q=64] @ {N_NORTH}: {ms_bq:.1f} ms")
+            ms_bq, _, ok_bq = timed_ms(lambda: bq_fn(G10, N_NORTH, f10),
+                                       iters=1, loops=2, rtt=rtt)
+            recap(f"north-star: bulyan[q=64] @ {N_NORTH}: {ms_bq:.1f} ms"
+                  f"{'' if ok_bq else '  [TIMING INVALID]'}")
         with phase("north-star-bulyan-exact", 600):
             gate()
             if G10 is None:
                 raise RuntimeError("G10 unavailable (creation failed)")
             b1_fn = jax.jit(bulyan, static_argnums=(1, 2))
-            ms_b1, _ = timed_ms(lambda: b1_fn(G10, N_NORTH, f10),
-                                iters=1, loops=1, rtt=rtt)
-            recap(f"north-star: bulyan[q=1 exact] @ {N_NORTH}: {ms_b1:.1f} ms")
+            ms_b1, _, ok_b1 = timed_ms(lambda: b1_fn(G10, N_NORTH, f10),
+                                       iters=1, loops=1, rtt=rtt)
+            recap(f"north-star: bulyan[q=1 exact] @ {N_NORTH}: "
+                  f"{ms_b1:.1f} ms{'' if ok_b1 else '  [TIMING INVALID]'}")
         del G10
     elif on_accel:
         recap("north-star suite SKIPPED: relay died before it could run")
@@ -451,6 +525,28 @@ def main():
                 recap(f"north-star: median[host native] @ {N_NORTH}: "
                       f"{s_mdh:.1f} s")
                 del G10h
+        # Hybrid-path cost model, CPU side (VERDICT r3 #2): what the
+        # bulyan[selection_impl='host'] pure_callback pays to marshal
+        # the (10240, 10240) f32 D (420 MB) through the callback
+        # machinery on this backend — the D-fetch term of the hybrid,
+        # measurable without the chip.  (The full hybrid at 10k needs
+        # the device Gram; on XLA:CPU that alone is ~minutes, so only
+        # the marshal term is benched here.)
+        with phase("hybrid-d-marshal", 300):
+            D10 = jnp.zeros((N_NORTH, N_NORTH), jnp.float32)
+
+            def marshal_cb(d):
+                return np.float32(d[0, 0])
+
+            cb_fn = jax.jit(lambda d: jax.pure_callback(
+                marshal_cb, jax.ShapeDtypeStruct((), jnp.float32), d))
+            float(cb_fn(D10))   # compile + warm
+            t0 = time.perf_counter()
+            float(cb_fn(D10))
+            s_marshal = time.perf_counter() - t0
+            recap(f"hybrid D-marshal: (10240,10240) f32 pure_callback "
+                  f"on {dev.platform}: {1e3 * s_marshal:.1f} ms")
+            del D10
 
     # --- secondary: full FL round throughput (stderr diagnostic) --------
     with phase("fl-throughput", 600):
@@ -525,9 +621,11 @@ def main():
     log("=== essentials ===")
     for line in RECAP:
         if ("device:" in line or "framework krum" in line
-                or "north-star" in line or "mfu[krum" in line):
+                or "north-star" in line or "mfu[krum" in line
+                or "VALIDITY" in line):
             log(line)
 
+    deadline_timer.cancel()  # main() finished: only one emitter remains
     emit_result_json()
 
 
