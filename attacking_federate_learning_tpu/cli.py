@@ -71,7 +71,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "opt-in native host kernel")
     p.add_argument("-s", "--dataset", default=C.MNIST,
                    choices=[C.MNIST, C.CIFAR10, C.CIFAR100, C.SYNTH_MNIST,
-                            C.SYNTH_CIFAR10, C.SYNTH_MNIST_HARD],
+                            C.SYNTH_CIFAR10, C.SYNTH_MNIST_HARD,
+                            C.SYNTH_CIFAR10_HARD],
                    help="CIFAR100 runs the WRN-40-4 the reference defines "
                         "but never exposes (reference main.py:114 excludes "
                         "it; data_sets.py:108-173 defines it)")

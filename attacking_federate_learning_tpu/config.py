@@ -23,11 +23,12 @@ CIFAR100 = "CIFAR100"
 SYNTH_MNIST = "SYNTH_MNIST"      # MNIST-shaped deterministic synthetic data
 SYNTH_CIFAR10 = "SYNTH_CIFAR10"  # CIFAR10-shaped deterministic synthetic data
 SYNTH_MNIST_HARD = "SYNTH_MNIST_HARD"  # low-SNR variant for behavioral tests
+SYNTH_CIFAR10_HARD = "SYNTH_CIFAR10_HARD"  # low-SNR CIFAR-shaped variant
 
 # Per-dataset LR fading constants, reference main.py:144-149.
 FADING_RATES = {CIFAR10: 2000.0, MNIST: 10000.0, CIFAR100: 1500.0,
                 SYNTH_MNIST: 10000.0, SYNTH_CIFAR10: 2000.0,
-                SYNTH_MNIST_HARD: 10000.0}
+                SYNTH_MNIST_HARD: 10000.0, SYNTH_CIFAR10_HARD: 2000.0}
 
 
 @dataclasses.dataclass
@@ -327,12 +328,14 @@ MODEL_FAMILY = {"mnist_mlp": "mnist", "mnist_cnn": "mnist",
                 "wideresnet40_4": "cifar"}
 DATASET_FAMILY = {MNIST: "mnist", SYNTH_MNIST: "mnist",
                   SYNTH_MNIST_HARD: "mnist", CIFAR10: "cifar",
-                  SYNTH_CIFAR10: "cifar", CIFAR100: "cifar"}
+                  SYNTH_CIFAR10: "cifar", SYNTH_CIFAR10_HARD: "cifar",
+                  CIFAR100: "cifar"}
 
 
 def default_model_for(dataset: str) -> str:
     return {
         MNIST: "mnist_mlp", SYNTH_MNIST: "mnist_mlp",
         CIFAR10: "cifar10_cnn", SYNTH_CIFAR10: "cifar10_cnn",
+        SYNTH_CIFAR10_HARD: "cifar10_cnn",
         CIFAR100: "wideresnet40_4",
     }.get(dataset, "mnist_mlp")

@@ -131,7 +131,8 @@ def load_cifar100(data_dir: str) -> Dataset:
 def make_synthetic(shape, num_classes: int, n_train: int, n_test: int,
                    seed: int, name: str,
                    mean, std, signal: float = 0.35,
-                   noise_scale: float = 0.25) -> Dataset:
+                   noise_scale: float = 0.25,
+                   smooth_protos: bool = False) -> Dataset:
     """Class-prototype Gaussians in pixel space, then normalized.
 
     Each class c gets a fixed prototype image p_c; samples are
@@ -140,9 +141,25 @@ def make_synthetic(shape, num_classes: int, n_train: int, n_test: int,
     rounds (the reference's checkpoint threshold, main.py:84); lower
     signal-to-noise (e.g. the *_HARD variants) slows convergence so
     attack-vs-defense accuracy deltas are visible in behavioral tests.
+
+    ``smooth_protos``: draw the prototypes on a coarse (H/4, W/4) grid
+    and nearest-upsample, giving them the low-frequency spatial
+    structure conv+pool architectures are biased toward.  Per-pixel
+    i.i.d. prototypes are near-invisible to a CNN (pooling averages
+    them out — measured: cifar10_cnn stays at random accuracy on them
+    while an MLP learns fine), so CNN-targeted synthetics must be
+    spatially smooth to exercise real convergence.
     """
     rng = np.random.default_rng(seed)
-    protos = rng.standard_normal((num_classes,) + shape).astype(np.float32)
+    if smooth_protos and len(shape) == 3 and shape[1] % 4 == 0 \
+            and shape[2] % 4 == 0:
+        coarse = rng.standard_normal(
+            (num_classes, shape[0], shape[1] // 4, shape[2] // 4)
+        ).astype(np.float32)
+        protos = np.kron(coarse, np.ones((1, 1, 4, 4), np.float32))
+    else:
+        protos = rng.standard_normal(
+            (num_classes,) + shape).astype(np.float32)
     protos /= np.linalg.norm(protos.reshape(num_classes, -1), axis=1).reshape(
         (num_classes,) + (1,) * len(shape)) / np.sqrt(np.prod(shape))
 
@@ -206,4 +223,16 @@ def load_dataset(name: str, data_dir: str = "data", seed: int = 0,
         return make_synthetic((1, 28, 28), 10, synth_train, synth_test, seed,
                               name, MNIST_MEAN, MNIST_STD,
                               signal=0.12, noise_scale=0.30)
+    if name == C.SYNTH_CIFAR10_HARD:
+        # CIFAR-shaped stand-in for convergence studies of the conv-net
+        # + shadow-train composition (reference backdoor.py:108-159 at
+        # data_sets.py:33-61 scale): spatially-smooth prototypes so a
+        # CNN can actually learn them (see make_synthetic), at an SNR
+        # low enough that training stays non-saturated over ~100+
+        # rounds — the regime where the backdoor clip envelope is alive
+        # (CLAUDE.md behavioral facts).
+        return make_synthetic((3, 32, 32), 10, synth_train, synth_test, seed,
+                              name, CIFAR10_MEAN, CIFAR10_STD,
+                              signal=0.20, noise_scale=0.30,
+                              smooth_protos=True)
     raise ValueError(f"Unknown dataset {name!r}")
