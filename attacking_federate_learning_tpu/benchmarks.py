@@ -48,9 +48,14 @@ def _cells():
          "alie",
          "CIFAR-10, 1000 clients, Bulyan vs ALIE - O(n^2 d) stress"),
         ("noniid_10k_grid",
+         # bulyan_selection_impl='host': at full scale the traced exact
+         # selection is ~5,200 sequential O(n^2) trips PER ROUND; the
+         # hybrid (device Gram -> one (n,n) marshal -> native selection)
+         # is the affordable exact-semantics route on both backends.
          dict(dataset=C.MNIST, users_count=10_000, mal_prop=0.24,
               partition="dirichlet", batch_size=32,
-              data_placement="host_stream"),
+              data_placement="host_stream",
+              bulyan_selection_impl="host"),
          "grid",
          "non-IID, 10k clients, {Krum,TrimmedMean,Bulyan} x "
          "{ALIE,backdoor} grid - overnight north star"),

@@ -88,3 +88,23 @@ def test_sort_heavy_defenses_under_sharding(defense):
     got = np.asarray(jax.jit(DEFENSES[defense],
                              static_argnums=(1, 2))(Gs, 16, 2))
     np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+@needs_8
+def test_hybrid_bulyan_selection_under_sharding():
+    """The hybrid exact path (selection_impl='host', round 4) must work
+    with a client-sharded operand: GSPMD gathers the (n, n) D for the
+    pure_callback and the device gather + trim-mean stay sharded."""
+    import functools
+
+    from attacking_federate_learning_tpu.defenses.kernels import bulyan
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    G = grads(16, 100, seed=3)
+    want = np.asarray(bulyan(G, 16, 2))
+    mesh = make_mesh((8, 1))
+    Gs = jax.device_put(G, NamedSharding(mesh, P("clients", None)))
+    got = np.asarray(jax.jit(
+        functools.partial(bulyan, selection_impl="host"),
+        static_argnums=(1, 2))(Gs, 16, 2))
+    np.testing.assert_allclose(got, want, atol=1e-5)
