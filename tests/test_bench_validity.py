@@ -1,0 +1,69 @@
+"""The bench validity gate (VERDICT r3 #1): the mechanisms that make an
+invalid TPU capture impossible to record — MFU ceiling, RTT floor,
+once-guarded emission — pinned as unit behavior so a bench.py refactor
+can't silently drop them before the next relay window.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+import numpy as np
+import pytest
+
+import bench
+
+
+@pytest.fixture(autouse=True)
+def fresh_bench_state():
+    """bench module state (RESULT/RECAP/_EMITTED) is global; isolate."""
+    importlib.reload(bench)
+    yield
+
+
+def test_mfu_line_marks_invalid_above_bf16_peak():
+    # 667 GFLOP in 0.09 ms = 7.4 PFLOP/s — the round-3 garbage number.
+    frac = bench.mfu_line("krum_gram", 667e9, 0.09, "tpu")
+    assert frac is not None and frac > 1.0
+    assert bench.RESULT.get("valid") is False
+    assert any("measurement broken" in r
+               for r in bench.RESULT["invalid_reasons"])
+
+
+def test_mfu_line_valid_below_peak_and_none_off_accel():
+    frac = bench.mfu_line("krum_gram", 667e9, 40.0, "tpu")  # ~17 TFLOP/s
+    assert frac is not None and frac < 1.0
+    assert "valid" not in bench.RESULT          # nothing poisoned
+    assert bench.mfu_line("x", 1e9, 1.0, "cpu") is None
+
+
+def test_timed_ms_flags_wall_below_rtt():
+    import jax.numpy as jnp
+
+    x = jnp.zeros((4,))
+    # A trivial op's wall is microseconds; an absurd RTT must flag it.
+    ms, _, ok = bench.timed_ms(lambda: x + 1.0, iters=2, loops=1,
+                               rtt=10_000.0)
+    assert not ok
+    assert ms >= 0.05                            # clamp held
+
+    ms2, _, ok2 = bench.timed_ms(lambda: x + 1.0, iters=2, loops=1,
+                                 rtt=0.0)
+    assert ok2 and ms2 >= 0.05
+
+
+def test_emit_result_json_is_once_guarded(capsys):
+    bench.RESULT.update(metric="m", value=1.0, unit="ms",
+                        vs_baseline=1.0, valid=True)
+    bench.emit_result_json()
+    bench.emit_result_json()                     # deadline-timer replay
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1 and '"metric": "m"' in out[0]
+
+
+def test_mark_invalid_deduplicates_reasons():
+    bench.RESULT.update(metric="m", value=1.0, valid=True)
+    bench.mark_invalid("same reason")
+    bench.mark_invalid("same reason")
+    assert bench.RESULT["invalid_reasons"] == ["same reason"]
+    assert bench.RESULT["valid"] is False
