@@ -55,9 +55,10 @@ tail -30 "$OUT/bench_$STAMP.log"
 
 relay_probe || { echo "relay died after bench" >&2; exit 1; }
 echo "== step 2: TPU-backend test re-run (fused backdoor, Mosaic pallas,"
-echo "   engine) =="
+echo "   engine, defense kernels incl. the hybrid Bulyan callback) =="
 FL_TEST_TPU=1 timeout 3600 python -m pytest \
   tests/test_pallas.py tests/test_engine.py tests/test_parallel.py \
+  tests/test_defenses.py \
   -q --no-header 2>&1 | tee "$OUT/pytest_tpu_$STAMP.log" | tail -15
 
 relay_probe || { echo "relay died after pytest" >&2; exit 1; }
