@@ -46,7 +46,9 @@ trap 'rm -rf "$LOCK" 2>/dev/null' EXIT
 if ! relay_probe; then echo "relay dead; aborting" >&2; exit 1; fi
 
 echo "== step 1: bench.py (headline + 10k north star + per-impl) =="
-timeout 5400 python bench.py >"$OUT/bench_$STAMP.json" \
+# Outer bound must exceed bench's internal 5700 s final deadline so the
+# clean banked-results exit (not this SIGTERM) is what ends a slow run.
+timeout 6000 python bench.py >"$OUT/bench_$STAMP.json" \
   2>"$OUT/bench_$STAMP.log"
 echo "bench rc=$? json:"; cat "$OUT/bench_$STAMP.json"
 tail -30 "$OUT/bench_$STAMP.log"
