@@ -6,11 +6,41 @@ malicious cohort's mean shifted down by z standard deviations per coordinate,
 identical).  z is the fixed CLI constant num_std (default 1.5, reference
 main.py:109-110) — the reference does not derive the paper's z_max from the
 phi-quantile formula, and neither does this default path (SURVEY.md §2.4 #3).
+``num_std='auto'`` (beyond-reference) computes it via :func:`paper_z`.
 """
 
 from __future__ import annotations
 
+from statistics import NormalDist
+
 from attacking_federate_learning_tpu.attacks.base import Attack, cohort_stats
+
+
+def paper_z(users_count: int, corrupted_count: int) -> float:
+    """The ALIE paper's z_max (Baruch et al., NeurIPS'19 §3.1): the
+    largest shift such that the crafted value still looks like a
+    majority-side sample to a trimming defense.  With
+    ``s = floor(n/2 + 1) - f`` honest supporters required,
+
+        z_max = Phi^-1((n - f - s) / (n - f))
+
+    — the quantile below which fewer than s honest workers are expected.
+    The reference never computes this (its z is the CLI constant);
+    ``num_std='auto'`` opts in.  The result is clamped to [0, z(0.9999)]:
+    p <= 0.5 means the formula grants no positive hiding room (small
+    cohorts / few attackers) and returns z = 0 — a negative z would
+    invert the shift AND the backdoor clip envelope — while s <= 0
+    (attacker majority) drives p past 1, where z_max is unbounded, so
+    it caps at the 0.9999 quantile (z ~ 3.72)."""
+    n, f = int(users_count), int(corrupted_count)
+    honest = n - f
+    if honest <= 0:
+        return 0.0
+    s = n // 2 + 1 - f
+    p = (honest - s) / honest
+    if p <= 0.5:
+        return 0.0
+    return float(NormalDist().inv_cdf(min(p, 0.9999)))
 
 
 class DriftAttack(Attack):

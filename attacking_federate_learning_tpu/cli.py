@@ -27,8 +27,11 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native federated-learning attack/defense simulator")
     p.add_argument("-m", "--mal-prop", default=0.24, type=float,
                    help="proportion of malicious users")
-    p.add_argument("-z", "--num_std", default=1.5, type=float,
-                   help="how many standard deviations the attacker shifts")
+    p.add_argument("-z", "--num_std", default=1.5,
+                   type=lambda s: s if s == "auto" else float(s),
+                   help="how many standard deviations the attacker "
+                        "shifts; 'auto' computes the ALIE paper's z_max "
+                        "from (n, f) (beyond-reference)")
     p.add_argument("-d", "--defense", default="NoDefense",
                    choices=["NoDefense", "Bulyan", "TrimmedMean", "Krum",
                             "FLTrust", "Median", "GeoMedian", "NormBound",

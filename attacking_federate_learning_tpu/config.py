@@ -54,7 +54,11 @@ class ExperimentConfig:
     local_steps: int = 1
 
     # --- attack ---------------------------------------------------------
-    num_std: float = 1.5             # ALIE z, reference main.py:109
+    # ALIE z, reference main.py:109.  'auto' (beyond-reference) resolves
+    # at construction to the ALIE paper's z_max via attacks/alie.py:
+    # paper_z(n, f), so every consumer (and the CSV name schema) sees
+    # the numeric value.
+    num_std: object = 1.5
     backdoor: object = False         # False | 'pattern' | int sample index
     alpha: float = 4.0               # anchor-loss weight, reference main.py:142
     mal_epochs: int = 5              # shadow-net epochs, reference main.py:139
@@ -297,6 +301,13 @@ class ExperimentConfig:
             raise ValueError(
                 f"participation must be in (0, 1], got "
                 f"{self.participation}")
+        if self.num_std == "auto":
+            from attacking_federate_learning_tpu.attacks.alie import paper_z
+            self.num_std = paper_z(self.users_count, self.corrupted_count)
+        elif not isinstance(self.num_std, (int, float)):
+            raise ValueError(
+                f"num_std must be a number or 'auto', got "
+                f"{self.num_std!r}")
         if self.fading_rate is None:
             self.fading_rate = FADING_RATES.get(self.dataset, 10000.0)
         if self.model is None:

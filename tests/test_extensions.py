@@ -420,3 +420,52 @@ def test_median_host_impl_matches_xla():
     Gn[2, 3] = np.nan
     out = np.asarray(median(jnp.asarray(Gn), 6, 1, impl="host"))
     assert np.isnan(out[3]) and np.isfinite(np.delete(out, 3)).all()
+
+
+# --------------------------------------------------------------------------
+# ALIE paper z_max (num_std='auto', round 4)
+# --------------------------------------------------------------------------
+def test_paper_z_formula_and_degenerates():
+    from statistics import NormalDist
+
+    from attacking_federate_learning_tpu.attacks.alie import paper_z
+
+    # n=50, f=12: s = 26-12 = 14 supporters, p = 24/38 -> z ~ 0.336.
+    assert abs(paper_z(50, 12) - NormalDist().inv_cdf(24 / 38)) < 1e-12
+    assert 0.30 < paper_z(50, 12) < 0.37
+    # Small cohorts give tiny/zero hiding room (the paper's own curve):
+    # n=10, f=2 -> s=4 of 8 honest -> p=0.5 -> z=0 exactly.
+    assert paper_z(10, 2) == 0.0
+    # Half-malicious cohorts still get headroom (s=1 supporter):
+    # n=8, f=4 -> p = 3/4 -> z ~ 0.674.
+    assert abs(paper_z(8, 4) - NormalDist().inv_cdf(0.75)) < 1e-12
+    assert paper_z(4, 4) == 0.0                  # no honest workers
+    assert 3.5 < paper_z(10, 9) < 4.0            # majority, capped quantile
+    # p < 0.5 (no positive hiding room) clamps to 0, never negative —
+    # a negative z would invert the backdoor clip envelope.
+    assert paper_z(10, 1) == 0.0                 # p = 4/9 < 0.5
+    for n in range(4, 60):
+        for f in range(0, n // 2 + 1):
+            assert paper_z(n, f) >= 0.0, (n, f)
+
+
+def test_num_std_auto_resolves_in_config():
+    from attacking_federate_learning_tpu.attacks.alie import paper_z
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+
+    cfg = ExperimentConfig(users_count=50, mal_prop=0.24, num_std="auto")
+    assert isinstance(cfg.num_std, float)
+    assert cfg.num_std == paper_z(50, 12)
+    # The CSV schema sees the resolved number, not the string.
+    assert "auto" not in cfg.csv_name()
+    with pytest.raises(ValueError):
+        ExperimentConfig(num_std="bogus")
+
+
+def test_num_std_auto_cli_surface():
+    from attacking_federate_learning_tpu import cli
+
+    args = cli.build_parser().parse_args(["-z", "auto"])
+    assert args.num_std == "auto"
+    args = cli.build_parser().parse_args(["-z", "1.25"])
+    assert args.num_std == 1.25
