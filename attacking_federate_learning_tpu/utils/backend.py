@@ -36,20 +36,63 @@ def _fallback_to_cpu(reason: str):
     os.execve(sys.executable, [sys.executable] + sys.argv, os.environ)
 
 
+def host_cache_fingerprint():
+    """Host fingerprint for the persistent-compile-cache directory.
+
+    XLA's persistent cache keys entries on the HLO and compile options
+    but NOT on the host CPU's feature set, and this repo's .jax_cache
+    survives across rounds on hosts that are not identical: BENCH_r04's
+    tail opened with XLA's warning that a cached executable "was
+    compiled for a different CPU feature set" and "could lead to
+    execution errors such as SIGILL".  A SIGILL inside the short TPU
+    capture window would burn it.  Keying the cache *directory* on the
+    CPU feature flags (+ arch + jax version) makes a different host a
+    different, initially-empty directory instead of a crash risk, while
+    same-host processes still share warm compiles.
+    """
+    import hashlib
+    import platform
+
+    bits = [platform.machine()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # One line suffices: all cores on a host report the same
+                # feature set ("flags" on x86, "Features" on arm).
+                if line.startswith(("flags", "Features")):
+                    bits.append(line.strip())
+                    break
+    except OSError:
+        bits.append(platform.processor())
+    try:
+        # Version via metadata, NOT `import jax`: callers (conftest)
+        # need the fingerprint before jax is imported, because jax 0.9
+        # reads JAX_COMPILATION_CACHE_DIR only at import time.
+        from importlib.metadata import version
+        bits.append(version("jax"))
+    except Exception:
+        pass
+    return hashlib.sha256("|".join(bits).encode()).hexdigest()[:12]
+
+
 def enable_compile_cache(path=None):
     """Persistent XLA compile cache shared by every entry point (tests
     already use it via conftest, anchored to the same repo-root
     .jax_cache).  Compiles survive across processes — critical when TPU
     relay windows are short: a second bench/benchmarks run skips the
-    20-40 s first compiles.  A user-set JAX_COMPILATION_CACHE_DIR wins;
-    jax.config.update is just the explicit (import-order-proof) way to
-    apply the same setting."""
+    20-40 s first compiles.  A user-set JAX_COMPILATION_CACHE_DIR wins
+    verbatim (no fingerprint appended — explicit settings are obeyed);
+    the default path gains a host-fingerprint subdirectory so stale
+    cross-host executables can never SIGILL a capture run (see
+    :func:`host_cache_fingerprint`).  jax.config.update is just the
+    explicit (import-order-proof) way to apply the same setting."""
     import jax
 
     if path is None:
         path = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
             os.path.dirname(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__)))), ".jax_cache")
+                os.path.abspath(__file__)))), ".jax_cache",
+            host_cache_fingerprint())
     jax.config.update("jax_compilation_cache_dir", path)
     if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

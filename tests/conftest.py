@@ -24,9 +24,23 @@ if not TPU_MODE:
             flags + " --xla_force_host_platform_device_count=8").strip()
 # Persistent compile cache: the suite compiles dozens of kernel variants and
 # this box has one core — caching cuts re-runs from minutes to seconds.
+# The path carries a host fingerprint (utils/backend.py) so executables
+# cached by a host with a different CPU feature set are never loaded here
+# (the SIGILL risk XLA warned about in BENCH_r04).  Imported by file path
+# to keep the package __init__ (and its jax-touching imports) out of the
+# env-setup phase.
+import importlib.util as _ilu  # noqa: E402
+
+_spec = _ilu.spec_from_file_location(
+    "_fl_backend", os.path.join(os.path.dirname(__file__), os.pardir,
+                                "attacking_federate_learning_tpu", "utils",
+                                "backend.py"))
+_backend = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(_backend)
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.join(os.path.dirname(__file__), os.pardir,
-                                   ".jax_cache"))
+                                   ".jax_cache",
+                                   _backend.host_cache_fingerprint()))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 # Because jax is already imported (see above), the only effective platform
@@ -38,6 +52,14 @@ import jax  # noqa: E402
 
 if not TPU_MODE:
     jax.config.update("jax_platforms", "cpu")
+# Same already-imported reality for the cache settings: jax 0.9 reads the
+# cache env vars at import time only, and sitecustomize (or an import in
+# the fingerprint path) may have imported jax before the setdefaults
+# above — so apply them to the live config explicitly.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                  float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
 
 import pytest  # noqa: E402
 

@@ -18,10 +18,11 @@ while true; do
     # — one TPU process at a time.  (CPU-pinned benchmark/test runs are
     # fine to overlap; TPU-bound pytest/benchmarks runs are launched by
     # tpu_capture.sh itself under the lock.)
-    # Any interpreter spelling counts (python3, absolute path, -m …);
+    # Any interpreter spelling counts (python3, absolute path, flags
+    # between interpreter and script, and the '-m bench' module form);
     # a live capture-lock holder also counts as busy even though
     # tpu_capture.sh would itself exit 2 — cheaper to wait here.
-    if pgrep -f 'python[0-9.]*[^ ]* .*bench\.py' >/dev/null \
+    if pgrep -f 'python[0-9.]*[^ ]* .*(bench\.py|-m bench( |$))' >/dev/null \
         || { holder=$(cat /tmp/tpu_capture.lock/pid 2>/dev/null) \
              && [ -n "$holder" ] && kill -0 "$holder" 2>/dev/null; }; then
       echo "$(date +%T) relay live but TPU busy; waiting" >>"$WLOG"
