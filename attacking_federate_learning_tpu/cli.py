@@ -108,8 +108,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-o", "--output", type=str,
                    help="output file for results (tee)")
     p.add_argument("--partition", default="iid",
-                   choices=["iid", "dirichlet"])
+                   choices=["iid", "dirichlet", "femnist_style"])
     p.add_argument("--dirichlet-alpha", default=0.5, type=float)
+    p.add_argument("--style-strength", default=0.25, type=float,
+                   help="femnist_style per-client contrast/brightness "
+                        "spread (data/partition.py client_style_params)")
     p.add_argument("--seed", default=0, type=int)
     p.add_argument("--data-dir", default="data", type=str)
     p.add_argument("--log-dir", default="logs", type=str,
@@ -238,6 +241,7 @@ def config_from_args(args) -> ExperimentConfig:
         seed=args.seed,
         partition=args.partition,
         dirichlet_alpha=args.dirichlet_alpha,
+        style_strength=args.style_strength,
         data_dir=args.data_dir,
         log_dir=args.log_dir,
         run_dir=args.run_dir,

@@ -58,7 +58,7 @@ class ExperimentConfig:
     # at construction to the ALIE paper's z_max via attacks/alie.py:
     # paper_z(n, f), so every consumer (and the CSV name schema) sees
     # the numeric value.
-    num_std: object = 1.5
+    num_std: "float | str" = 1.5
     backdoor: object = False         # False | 'pattern' | int sample index
     alpha: float = 4.0               # anchor-loss weight, reference main.py:142
     mal_epochs: int = 5              # shadow-net epochs, reference main.py:139
@@ -98,9 +98,15 @@ class ExperimentConfig:
     synth_test: int = 2000
 
     # --- data partition -------------------------------------------------
-    partition: str = "iid"           # 'iid' (DistributedSampler-equivalent,
-                                     # reference user.py:49-54) | 'dirichlet'
+    # 'iid' (DistributedSampler-equivalent, reference user.py:49-54) |
+    # 'dirichlet' (label skew) | 'femnist_style' (per-client affine
+    # input transform over IID shards — the feature-shift axis of
+    # SURVEY §7.2 M4's "FEMNIST"; data/partition.py
+    # client_style_params).
+    partition: str = "iid"
     dirichlet_alpha: float = 0.5
+    style_strength: float = 0.25     # 'femnist_style' contrast/brightness
+                                     # spread; 0 degenerates to IID
 
     # --- per-round client participation (beyond-reference) -------------
     # Fraction of clients sampled each round (the reference uses every
@@ -304,7 +310,10 @@ class ExperimentConfig:
         if self.num_std == "auto":
             from attacking_federate_learning_tpu.attacks.alie import paper_z
             self.num_std = paper_z(self.users_count, self.corrupted_count)
-        elif not isinstance(self.num_std, (int, float)):
+        elif (isinstance(self.num_std, bool)
+                or not isinstance(self.num_std, (int, float))):
+            # bool is an int subclass; num_std=True silently meaning
+            # z=1.0 would be a config typo accepted as physics.
             raise ValueError(
                 f"num_std must be a number or 'auto', got "
                 f"{self.num_std!r}")

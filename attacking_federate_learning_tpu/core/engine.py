@@ -156,6 +156,26 @@ class FederatedExperiment:
                     shardings.place(self.shards, self.train_x, self.train_y,
                                     self.state))
 
+        # FEMNIST-style feature shift (SURVEY §7.2 M4): each client sees
+        # the shared pool through its own affine transform a_i*x + b_i
+        # (data/partition.py client_style_params).  Raw-data consumers,
+        # deliberately: the global test set stays untransformed
+        # (accuracy is measured on the common distribution) and the
+        # backdoor attacker's shadow train reads the raw dataset (the
+        # attacker controls its own pipeline).  Styled consumers: the
+        # training batches below AND the metadata pool (collect_metadata
+        # applies each contributor's transform — those samples model the
+        # client's own view).
+        if cfg.partition == "femnist_style":
+            from attacking_federate_learning_tpu.data.partition import (
+                client_style_params
+            )
+            a_sty, b_sty = client_style_params(self.n, cfg.style_strength,
+                                               cfg.seed)
+            self._style = (jnp.asarray(a_sty), jnp.asarray(b_sty))
+        else:
+            self._style = None
+
         # Reference parity: augmentation is part of the CIFAR100 train
         # pipeline only (reference data_sets.py:157-166); image-shaped
         # data required (the MNIST wire is flat).
@@ -285,7 +305,17 @@ class FederatedExperiment:
                 picked.extend(rng.choice(pool, size=min(k, len(pool)),
                                          replace=False).tolist())
             picked = np.asarray(picked[:take], np.int64)
-            meta_x.append(xs[picked])
+            x_i = xs[picked]
+            if self._style is not None:
+                # Contributed samples are the client's OWN view of the
+                # data: under femnist_style they carry that client's
+                # a_i*x + b_i transform, exactly like its training
+                # inputs — otherwise a FLTrust-style consumer would
+                # score honest styled gradients against an unstyled
+                # reference distribution no client actually has.
+                a, b = self._style
+                x_i = np.float32(a[i]) * x_i + np.float32(b[i])
+            meta_x.append(x_i)
             meta_y.append(ys[picked])
         return np.concatenate(meta_x), np.concatenate(meta_y)
 
@@ -303,6 +333,19 @@ class FederatedExperiment:
             )
             xs = reflect_crop_flip(xs, round_augment_key(self.cfg.seed, t))
         return xs
+
+    def _apply_style(self, xs, participants):
+        """Per-client affine style transform ('femnist_style' partition):
+        row i of the cohort batch becomes a_i*xs_i + b_i — one fused
+        broadcast multiply-add inside the round program, so the feature
+        shift costs nothing extra on device."""
+        if self._style is None:
+            return xs
+        a, b = self._style
+        if participants is not None:
+            a, b = a[participants], b[participants]
+        shape = (xs.shape[0],) + (1,) * (xs.ndim - 1)
+        return a.reshape(shape) * xs + b.reshape(shape)
 
     def _participants(self, t):
         """Round-t cohort ids, or None under full participation: the
@@ -348,9 +391,16 @@ class FederatedExperiment:
         round's pre-transferred (xs, ys) instead."""
         cfg = self.cfg
         if batches is None:
-            xs, ys = self._gather_batches(t, self._participants(t))
+            part = self._participants(t)
+            xs, ys = self._gather_batches(t, part)
         else:
             xs, ys = batches
+            # The streaming prefetcher derives the identical cohort ids
+            # (platform-invariant RNG, _participants_host), so re-deriving
+            # here keeps the style rows aligned with the streamed batch.
+            part = (self._participants(t) if self._style is not None
+                    else None)
+        xs = self._apply_style(xs, part)
         xs = self._maybe_augment(xs, t)
         # Split the flat (m, k*B) gather into k local-step minibatches.
         k, B = cfg.local_steps, cfg.batch_size

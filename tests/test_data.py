@@ -42,6 +42,29 @@ def test_dirichlet_shards_shape_and_skew():
     assert hist.max() > hist.sum() * 0.25
 
 
+def test_femnist_style_partition_shards_and_params():
+    # Index side: identical to IID (the non-IIDness is the input
+    # transform, not example assignment).
+    labels = np.repeat(np.arange(5), 20)
+    np.testing.assert_array_equal(
+        P.make_shards("femnist_style", labels, 4, seed=7),
+        P.iid_shards(len(labels), 4, 7))
+    # Style side: deterministic per seed, bounded by strength, distinct
+    # across seeds, degenerate at strength 0.
+    a, b = P.client_style_params(6, 0.25, seed=3)
+    a2, b2 = P.client_style_params(6, 0.25, seed=3)
+    np.testing.assert_array_equal(a, a2)
+    np.testing.assert_array_equal(b, b2)
+    assert a.shape == b.shape == (6,) and a.dtype == np.float32
+    assert np.all(np.abs(a - 1.0) <= 0.25) and np.all(np.abs(b) <= 0.125)
+    assert len(np.unique(a)) == 6          # clients actually differ
+    a4, _ = P.client_style_params(6, 0.25, seed=4)
+    assert not np.array_equal(a, a4)
+    a0, b0 = P.client_style_params(6, 0.0, seed=3)
+    np.testing.assert_array_equal(a0, np.ones(6, np.float32))
+    np.testing.assert_array_equal(b0, np.zeros(6, np.float32))
+
+
 def test_synthetic_dataset_properties():
     ds = load_dataset(C.SYNTH_MNIST, seed=0, synth_train=512, synth_test=128)
     assert ds.train_x.shape == (512, 1, 28, 28)

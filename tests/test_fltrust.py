@@ -38,3 +38,26 @@ def test_fltrust_resists_alie_that_breaks_no_defense(hard_ds):
     attacked = hard_final_accuracy(hard_ds, "FLTrust", DriftAttack(0.5),
                                    0.21)
     assert attacked > 70.0
+
+
+def test_metadata_pool_carries_contributor_style():
+    """Under femnist_style the contributed samples are the client's OWN
+    (styled) view — the trust reference must live on the distribution
+    honest clients actually train on (core/engine.py collect_metadata)."""
+    def meta(partition, strength=0.5):
+        cfg = ExperimentConfig(
+            dataset=C.SYNTH_MNIST, users_count=6, mal_prop=0.0,
+            batch_size=16, epochs=1, defense="FLTrust",
+            collect_metadata=True, partition=partition,
+            style_strength=strength, synth_train=256, synth_test=64)
+        ds = load_dataset(cfg.dataset, seed=0, synth_train=256,
+                          synth_test=64)
+        exp = FederatedExperiment(cfg, attacker=NoAttack(), dataset=ds)
+        return exp.metadata
+
+    mx_iid, my_iid = meta("iid")
+    mx_sty, my_sty = meta("femnist_style")
+    np.testing.assert_array_equal(my_iid, my_sty)   # same picks
+    assert not np.array_equal(mx_iid, mx_sty)       # styled inputs
+    mx_s0, _ = meta("femnist_style", strength=0.0)
+    np.testing.assert_array_equal(mx_iid, mx_s0)    # strength 0 == iid

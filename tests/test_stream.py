@@ -50,6 +50,26 @@ def test_streamed_sharded_equals_device(hard_ds=None):
                                atol=2e-6, rtol=1e-6)
 
 
+def test_femnist_style_changes_training_and_zero_strength_is_iid():
+    w_iid = _weights("device")
+    w_sty = _weights("device", partition="femnist_style")
+    assert np.isfinite(w_sty).all()
+    assert not np.array_equal(w_iid, w_sty)   # the shift is real
+    np.testing.assert_array_equal(            # and strength 0 is IID
+        _weights("device", partition="femnist_style",
+                 style_strength=0.0), w_iid)
+
+
+def test_streamed_femnist_style_with_participation_equals_device():
+    # Pins the style-row/cohort alignment: the streamed path re-derives
+    # the cohort ids host-side, and the style transform must index the
+    # same rows (core/engine.py _compute_grads_impl).
+    kw = dict(users_count=8, participation=0.5,
+              partition="femnist_style")
+    np.testing.assert_array_equal(_weights("host_stream", **kw),
+                                  _weights("device", **kw))
+
+
 def test_streamed_augmented_cifar_equals_device():
     # allclose, not equal: the device path runs rounds as one fused span
     # while streaming runs per-round programs, and XLA's conv fusions
