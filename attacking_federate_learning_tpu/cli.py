@@ -35,7 +35,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-d", "--defense", default="NoDefense",
                    choices=["NoDefense", "Bulyan", "TrimmedMean", "Krum",
                             "FLTrust", "Median", "GeoMedian", "NormBound",
-                            "DnC"])
+                            "DnC", "CenteredClip"])
     p.add_argument("--attack", default="auto",
                    choices=["auto", "none", "alie", "backdoor", "signflip",
                             "noise", "minmax", "minsum"],
@@ -61,6 +61,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--geomed-eps", default=ExperimentConfig.geomed_eps,
                    type=float,
                    help="GeoMedian distance-smoothing floor")
+    p.add_argument("--cclip-tau", default=ExperimentConfig.cclip_tau,
+                   type=float,
+                   help="CenteredClip L2 clip radius (ICML'21)")
+    p.add_argument("--cclip-iters", default=ExperimentConfig.cclip_iters,
+                   type=int, help="CenteredClip re-centering trips")
     p.add_argument("--trimmed-mean-impl",
                    default=ExperimentConfig.trimmed_mean_impl,
                    choices=["xla", "host"],
@@ -269,6 +274,8 @@ def config_from_args(args) -> ExperimentConfig:
         dnc_filter_frac=args.dnc_filter_frac,
         geomed_iters=args.geomed_iters,
         geomed_eps=args.geomed_eps,
+        cclip_tau=args.cclip_tau,
+        cclip_iters=args.cclip_iters,
         trimmed_mean_impl=args.trimmed_mean_impl,
         median_impl=args.median_impl,
     )

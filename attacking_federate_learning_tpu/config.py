@@ -222,6 +222,10 @@ class ExperimentConfig:
     # config-surface standard as the DnC knobs above.
     geomed_iters: int = 10
     geomed_eps: float = 1e-6
+    # CenteredClip constants (defenses/centeredclip.py, ICML'21): clip
+    # radius and fixed re-centering trips.
+    cclip_tau: float = 10.0
+    cclip_iters: int = 5
     # Coordinate-wise kernels: 'xla' (default — keeps staged/fused
     # rounds on the same kernel, preserving bit-identity) or 'host'
     # (opt-in: the native column-blocked kernels, ~minutes -> ~25 s at
@@ -288,6 +292,10 @@ class ExperimentConfig:
         if self.dnc_filter_frac <= 0:
             raise ValueError(
                 f"dnc_filter_frac must be > 0, got {self.dnc_filter_frac}")
+        if self.cclip_iters < 1 or self.cclip_tau <= 0:
+            raise ValueError(
+                f"cclip_iters must be >= 1 and cclip_tau > 0, got "
+                f"{self.cclip_iters}/{self.cclip_tau}")
         if self.geomed_iters < 1 or self.geomed_eps <= 0:
             raise ValueError(
                 f"geomed_iters must be >= 1 and geomed_eps > 0, got "

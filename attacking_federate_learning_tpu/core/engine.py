@@ -118,6 +118,10 @@ class FederatedExperiment:
             self.defense_fn = functools.partial(
                 self.defense_fn, iters=cfg.geomed_iters,
                 eps=cfg.geomed_eps)
+        elif cfg.defense == "CenteredClip":
+            self.defense_fn = functools.partial(
+                self.defense_fn, tau=cfg.cclip_tau,
+                iters=cfg.cclip_iters)
 
         key = jax.random.key(cfg.seed)
         k_init, self.key_run = jax.random.split(key)

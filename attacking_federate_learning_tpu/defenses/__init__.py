@@ -10,3 +10,6 @@ from attacking_federate_learning_tpu.defenses.normbound import (  # noqa: F401
     norm_bounded_mean
 )
 from attacking_federate_learning_tpu.defenses.dnc import dnc  # noqa: F401,E402
+from attacking_federate_learning_tpu.defenses.centeredclip import (  # noqa: F401,E402
+    centered_clip
+)

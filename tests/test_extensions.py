@@ -469,3 +469,57 @@ def test_num_std_auto_cli_surface():
     assert args.num_std == "auto"
     args = cli.build_parser().parse_args(["-z", "1.25"])
     assert args.num_std == 1.25
+
+
+# --------------------------------------------------------------------------
+# CenteredClip (Karimireddy et al., ICML'21)
+# --------------------------------------------------------------------------
+def test_cclip_large_tau_is_exact_mean():
+    from attacking_federate_learning_tpu.defenses.centeredclip import (
+        centered_clip
+    )
+
+    G = grads_for(10, 32, seed=8)
+    out = np.asarray(centered_clip(jnp.asarray(G), 10, 2, tau=1e9))
+    np.testing.assert_allclose(out, G.mean(axis=0), atol=1e-5)
+
+
+def test_cclip_bounds_outlier_influence():
+    from attacking_federate_learning_tpu.defenses.centeredclip import (
+        centered_clip
+    )
+
+    G = grads_for(12, 40, seed=9)
+    G[0] = 1e4                      # unbounded Byzantine row
+    out = np.asarray(centered_clip(jnp.asarray(G), 12, 1, tau=10.0,
+                                   iters=5))
+    honest_center = G[1:].mean(axis=0)
+    mean = G.mean(axis=0)
+    # The outlier can move the estimate by <= iters*tau/n total, vs the
+    # plain mean's ~1e4*sqrt(d)/n displacement.
+    assert np.linalg.norm(out - honest_center) <= 5 * 10.0 / 12 + 1.0
+    assert (np.linalg.norm(out - honest_center)
+            < np.linalg.norm(mean - honest_center) / 50)
+
+
+def test_cclip_under_jit_and_engine():
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.attacks import make_attacker
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+
+    cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=12,
+                           mal_prop=0.25, batch_size=16, epochs=2,
+                           defense="CenteredClip", cclip_tau=5.0,
+                           synth_train=256, synth_test=64)
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=256, synth_test=64)
+    exp = FederatedExperiment(
+        cfg, attacker=make_attacker(cfg, dataset=ds, name="signflip"),
+        dataset=ds)
+    exp.run_round(0)
+    exp.run_round(1)
+    assert np.isfinite(np.asarray(exp.state.weights)).all()
+    assert int(exp.state.round) == 2

@@ -4,9 +4,19 @@
 # banks the headline story; every step tees into logs/tpu_capture/ and a
 # step failure does not stop the next step (the relay may flap).
 #
-#   bash tools/tpu_capture.sh [--quick]
+#   bash tools/tpu_capture.sh [--quick] [--rehearse]
 #
-# --quick: bench only (for a window expected to be very short).
+# --quick:    bench only (for a window expected to be very short).
+# --rehearse: full CPU-mode dress rehearsal (VERDICT r4 #1) — relay
+#             probes stubbed out, env pinned to CPU, cells at the CPU
+#             scale, cell-5 skipped (it has its own dedicated overnight
+#             job).  Proves the mechanics + prints the same [budget]
+#             lines the real window will, so the per-step ordering is
+#             provably sane before a window opens.
+#
+# Every step prints "[budget] <step>: <s>s (cum <s>s)" — in a real
+# window this is the record of where the window went; the rehearsal's
+# lines are the measured CPU floor of each step's startup+compute path.
 #
 # Serializes CAPTURES via a self-healing lock (exits 2 if a live holder
 # exists; a SIGKILLed holder's stale lock is reclaimed via its pid).
@@ -20,6 +30,43 @@ OUT=logs/tpu_capture
 mkdir -p "$OUT"
 STAMP=$(date +%H%M%S)
 LOCK=/tmp/tpu_capture.lock
+
+QUICK=0 REHEARSE=0
+for a in "$@"; do
+  case "$a" in
+    --quick) QUICK=1 ;;
+    --rehearse) REHEARSE=1 ;;
+    *) echo "unknown arg: $a (expected --quick / --rehearse)" >&2
+       # Fail fast: a misspelled --rehearse must not silently launch
+       # the real multi-hour capture on a live relay window.
+       exit 2 ;;
+  esac
+done
+
+# The rehearse/real deltas are captured ONCE here so the two paths
+# cannot drift: the env prefix for step 2 and the cell list for step 3.
+if [ "$REHEARSE" = 1 ]; then
+  export PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+  unset FL_TEST_TPU
+  STAMP="rehearse_$STAMP"
+  STEP2_ENV=()            # CPU backend: same suites, no TPU gate
+  # CPU defaults: scale 0.1, cells 1,2,4 (cell 3's ResNet shadow-train
+  # compile is impractical on one CPU core); cell-5 has its own
+  # dedicated overnight job.
+  STEP3_CELLS=()
+  probe() { return 0; }
+else
+  STEP2_ENV=(env FL_TEST_TPU=1)
+  STEP3_CELLS=(--cells 1,2,3,4)
+  probe() { relay_probe; }
+fi
+
+T_START=$SECONDS
+T_STEP=$SECONDS
+budget() {
+  echo "[budget] $1: $((SECONDS - T_STEP))s (cum $((SECONDS - T_START))s)"
+  T_STEP=$SECONDS
+}
 
 acquire() {
   if mkdir "$LOCK" 2>/dev/null; then
@@ -43,7 +90,7 @@ if ! acquire; then
 fi
 trap 'rm -rf "$LOCK" 2>/dev/null' EXIT
 
-if ! relay_probe; then echo "relay dead; aborting" >&2; exit 1; fi
+if ! probe; then echo "relay dead; aborting" >&2; exit 1; fi
 
 echo "== step 1: bench.py (headline + 10k north star + per-impl) =="
 # Outer bound must exceed bench's internal 5700 s final deadline so the
@@ -52,27 +99,37 @@ timeout 6000 python bench.py >"$OUT/bench_$STAMP.json" \
   2>"$OUT/bench_$STAMP.log"
 echo "bench rc=$? json:"; cat "$OUT/bench_$STAMP.json"
 tail -30 "$OUT/bench_$STAMP.log"
+budget "step1-bench"
 
-[ "${1:-}" = "--quick" ] && exit 0
+[ "$QUICK" = 1 ] && exit 0
 
-relay_probe || { echo "relay died after bench" >&2; exit 1; }
+probe || { echo "relay died after bench" >&2; exit 1; }
 echo "== step 2: TPU-backend test re-run (fused backdoor, Mosaic pallas,"
 echo "   engine, defense kernels incl. the hybrid Bulyan callback) =="
-FL_TEST_TPU=1 timeout 3600 python -m pytest \
+${STEP2_ENV[@]+"${STEP2_ENV[@]}"} timeout 3600 python -m pytest \
   tests/test_pallas.py tests/test_engine.py tests/test_parallel.py \
   tests/test_defenses.py \
   -q --no-header 2>&1 | tee "$OUT/pytest_tpu_$STAMP.log" | tail -15
+budget "step2-pytest"
 
-relay_probe || { echo "relay died after pytest" >&2; exit 1; }
-echo "== step 3: BASELINE cells 1-4 full scale =="
+probe || { echo "relay died after pytest" >&2; exit 1; }
+echo "== step 3: BASELINE cells =="
 timeout 7200 python -m attacking_federate_learning_tpu.benchmarks \
-  --rounds 10 --cells 1,2,3,4 2>&1 \
+  --rounds 10 ${STEP3_CELLS[@]+"${STEP3_CELLS[@]}"} 2>&1 \
   | tee "$OUT/cells_$STAMP.log" | grep -E '^\{' || true
+budget "step3-cells"
 
-relay_probe || { echo "relay died after cells 1-4" >&2; exit 1; }
+if [ "$REHEARSE" = 1 ]; then
+  echo "rehearsal complete (cell-5 skipped: dedicated overnight job);" \
+       "budget lines above are the CPU floor"
+  exit 0
+fi
+
+probe || { echo "relay died after cells 1-4" >&2; exit 1; }
 echo "== step 4: 10k non-IID grid (cell 5, overnight north star) =="
 timeout 14400 python -m attacking_federate_learning_tpu.benchmarks \
   --rounds 10 --cells 5 2>&1 \
   | tee "$OUT/cell5_$STAMP.log" | grep -E '^\{' || true
+budget "step4-cell5"
 
 echo "capture complete; logs in $OUT/"
