@@ -60,6 +60,16 @@ def test_femnist_style_changes_training_and_zero_strength_is_iid():
                  style_strength=0.0), w_iid)
 
 
+def test_femnist_style_sharded_equals_unsharded():
+    # The style params are (n,) host constants indexed inside the round
+    # program; under a (8,1) mesh the broadcast multiply-add must not
+    # perturb results beyond GSPMD reduction reordering.
+    kw = dict(users_count=16, partition="femnist_style")
+    np.testing.assert_allclose(
+        _weights("device", mesh_shape=(8, 1), **kw),
+        _weights("device", **kw), atol=2e-6, rtol=1e-6)
+
+
 def test_streamed_femnist_style_with_participation_equals_device():
     # Pins the style-row/cohort alignment: the streamed path re-derives
     # the cohort ids host-side, and the style transform must index the
