@@ -179,6 +179,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "distances, one (n, n) host marshal, native "
                         "incremental selection, device trim-mean "
                         "(the exact-semantics 10k accelerator route)")
+    p.add_argument("--bulyan-trim-impl",
+                   default=ExperimentConfig.bulyan_trim_impl,
+                   choices=["xla", "host"],
+                   help="Bulyan trimmed-mean tail: traced XLA kernel "
+                        "(default) or the native host kernel (the "
+                        "CPU-backend 10k opt-in; same standard as "
+                        "--trimmed-mean-impl)")
     p.add_argument("--distance-impl", default="auto",
                    choices=["auto", "xla", "pallas", "host", "ring",
                             "allgather"],
@@ -262,6 +269,7 @@ def config_from_args(args) -> ExperimentConfig:
         distance_dtype=args.distance_dtype,
         bulyan_batch_select=args.bulyan_batch_select,
         bulyan_selection_impl=args.bulyan_selection_impl,
+        bulyan_trim_impl=args.bulyan_trim_impl,
         server_uses_faded_lr=args.server_uses_faded_lr,
         log_round_stats=args.round_stats,
         synth_train=args.synth_train,

@@ -202,6 +202,12 @@ class ExperimentConfig:
     # marshal is only worth it when set_size sequential XLA trips cost
     # more than one D transfer (the 10k north-star regime).
     bulyan_selection_impl: str = "xla"
+    # Bulyan's final trimmed-mean tail: 'xla' (default, bit-stable with
+    # the traced path) or 'host' (native column-blocked kernel — the
+    # CPU-backend 10k opt-in; at full scale the XLA:CPU stable argsort
+    # over the (n-2f, d) selection dominates the whole hybrid).  Same
+    # opt-in standard and ulps caveat as trimmed_mean_impl.
+    bulyan_trim_impl: str = "xla"
     # Attack statistics over the malicious cohort only (reference
     # malicious.py:14-19), matching the ALIE threat model.
 
@@ -281,6 +287,10 @@ class ExperimentConfig:
             raise ValueError(
                 f"bulyan_selection_impl must be 'xla' or 'host', "
                 f"got {self.bulyan_selection_impl!r}")
+        if self.bulyan_trim_impl not in ("xla", "host"):
+            raise ValueError(
+                f"bulyan_trim_impl must be 'xla' or 'host', "
+                f"got {self.bulyan_trim_impl!r}")
         if self.attack_direction not in ("std", "sign", "unit"):
             raise ValueError(
                 f"attack_direction must be 'std', 'sign' or 'unit', "

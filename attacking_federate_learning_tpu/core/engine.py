@@ -239,6 +239,8 @@ class FederatedExperiment:
                 # Hybrid exact selection: device distances, one (n, n)
                 # D marshal, native host selection, device trim-mean.
                 kw["selection_impl"] = cfg.bulyan_selection_impl
+            if cfg.bulyan_trim_impl != "xla":
+                kw["trim_impl"] = cfg.bulyan_trim_impl
         impl = cfg.distance_impl
         if impl in ("ring", "allgather"):
             if self.shardings is None:

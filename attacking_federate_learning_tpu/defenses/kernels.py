@@ -266,14 +266,25 @@ def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
                                    distance_dtype=distance_dtype)]
 
 
-def trimmed_mean_of(users_grads, number_to_consider):
+def trimmed_mean_of(users_grads, number_to_consider, impl="xla"):
     """Median-anchored trimmed mean along the client axis.
 
     Per coordinate (reference defences.py:48-51): subtract the median, keep
     the ``number_to_consider`` values of smallest magnitude (stable order,
     matching Python's stable ``sorted`` on key=abs), and return their mean
     plus the median.
+
+    ``impl='host'`` is the single dispatch site for the native
+    column-blocked kernel — shared by :func:`trimmed_mean` and Bulyan's
+    ``trim_impl`` tail so the two can never diverge.
     """
+    if impl == "host":
+        from attacking_federate_learning_tpu.defenses.host import (
+            host_trimmed_mean_of
+        )
+        k_static = int(number_to_consider)
+        return host_coordwise(
+            lambda g: host_trimmed_mean_of(g, k_static), users_grads)
     med = jnp.median(users_grads, axis=0)
     dev = users_grads - med[None, :]
     order = jnp.argsort(jnp.abs(dev), axis=0, stable=True)
@@ -296,14 +307,7 @@ def trimmed_mean(users_grads, users_count, corrupted_count, impl="xla"):
     (tests/test_engine.py::test_backdoor_fused_equals_staged) holds
     only when both modes run the same kernel."""
     number_to_consider = users_grads.shape[0] - corrupted_count - 1
-    if impl == "host":
-        from attacking_federate_learning_tpu.defenses.host import (
-            host_trimmed_mean_of
-        )
-        k_static = int(number_to_consider)
-        return host_coordwise(
-            lambda g: host_trimmed_mean_of(g, k_static), users_grads)
-    return trimmed_mean_of(users_grads, number_to_consider)
+    return trimmed_mean_of(users_grads, number_to_consider, impl=impl)
 
 
 def host_coordwise(host_fn, users_grads):
@@ -360,7 +364,7 @@ def _host_bulyan_selection_of(D, users_count, corrupted_count, set_size,
 @DEFENSES.register("Bulyan")
 def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
            method="sort", distance_impl="xla", D=None, batch_select=1,
-           distance_dtype=None, selection_impl="xla"):
+           distance_dtype=None, selection_impl="xla", trim_impl="xla"):
     """Bulyan (reference defences.py:55-70): iteratively Krum-select
     n - 2f gradients (removing each winner from the pool, with the pool
     size — but not f — shrinking), then trim-mean the selection with
@@ -404,7 +408,15 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
     auto-dispatched, because host selection resolves f32 score ties by
     the native engine's comparator (see native/bulyan_select.cpp) while
     the traced loop uses f32 throughout — identical outside ulp-band
-    ties (tests/test_defenses.py pins hybrid==xla on plain inputs)."""
+    ties (tests/test_defenses.py pins hybrid==xla on plain inputs).
+
+    ``trim_impl='host'`` routes the final trimmed-mean tail through the
+    native column-blocked kernel (same opt-in standard — and the same
+    ulps-not-bits caveat — as ``trimmed_mean_impl``): at the 10k north
+    star the XLA:CPU stable argsort over the (n-2f, d) selection is
+    minutes per aggregation while the native kernel is seconds, and on
+    the CPU backend that tail, not the selection, is what dominates the
+    hybrid."""
     n, _ = users_grads.shape
     f = corrupted_count
     set_size = users_count - 2 * f
@@ -414,6 +426,13 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
     if selection_impl not in ("xla", "host"):
         raise ValueError(f"selection_impl must be 'xla' or 'host', "
                          f"got {selection_impl!r}")
+    if trim_impl not in ("xla", "host"):
+        raise ValueError(f"trim_impl must be 'xla' or 'host', "
+                         f"got {trim_impl!r}")
+
+    def trim_tail(selection, number_to_consider):
+        return trimmed_mean_of(selection, number_to_consider,
+                               impl=trim_impl)
     q = min(q, set_size)
     if D is None:
         impl = resolve_distance_impl(distance_impl, users_count,
@@ -439,7 +458,7 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
         selected = _host_bulyan_selection_of(
             Dm, users_count, corrupted_count, set_size, q, paper_scoring)
         selection = users_grads[selected]
-        return trimmed_mean_of(selection, set_size - 2 * f - 1)
+        return trim_tail(selection, set_size - 2 * f - 1)
 
     # Presort once for the traced selection loop.
     order = jnp.argsort(Dm, axis=1)
@@ -473,7 +492,7 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
 
     selection = users_grads[selected]  # (set_size, d), in selection order
     number_to_consider = set_size - 2 * f - 1
-    return trimmed_mean_of(selection, number_to_consider)
+    return trim_tail(selection, number_to_consider)
 
 
 def check_defense_args(name, users_count, corrupted_count):

@@ -360,6 +360,25 @@ class TestBulyanHybridSelection:
         honest = G[9:].mean(axis=0)
         assert np.linalg.norm(out - honest) < 2.0
 
+    def test_host_trim_tail_matches_xla_within_ulps(self):
+        # trim_impl='host' (the CPU-backend 10k tail opt-in) differs
+        # from XLA only by summation-order ulps, eager and jitted, and
+        # composes with the hybrid selection.
+        import functools
+
+        import jax
+        G = jnp.asarray(grads_for(23, 40, seed=29))
+        a = np.asarray(K.bulyan(G, 23, 5))
+        b = np.asarray(K.bulyan(G, 23, 5, trim_impl="host"))
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+        hyb = jax.jit(functools.partial(K.bulyan, selection_impl="host",
+                                        trim_impl="host"),
+                      static_argnums=(1, 2))
+        np.testing.assert_allclose(np.asarray(hyb(G, 23, 5)), a,
+                                   rtol=1e-6, atol=1e-6)
+        with pytest.raises(ValueError, match="trim_impl"):
+            K.bulyan(G, 23, 5, trim_impl="gpu")
+
     def test_invalid_selection_impl_raises(self):
         G = jnp.asarray(grads_for(11, 8, seed=0))
         with pytest.raises(ValueError, match="selection_impl"):

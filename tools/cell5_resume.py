@@ -1,0 +1,65 @@
+"""Resume the full-scale cell-5 grid from the TrimmedMean cells.
+
+The first full-scale run banked the two Krum cells
+(logs/grid_summary_r5_krum.jsonl) and then hit the measured XLA:CPU
+stable-argsort wall in TrimmedMean/alie (>14 min/round at n=10,000 —
+the same ~943 s/call regime BASELINE.md documents).  Per the round-5
+CPU-backend policy, the benchmark driver now opts into the native host
+kernels at this scale (benchmarks.py cell-5 overrides:
+trimmed_mean_impl='host', bulyan_trim_impl='host'); this script runs
+the remaining {TrimmedMean, Bulyan} x {alie, backdoor} cells under
+exactly those overrides, appending to a separate summary so the banked
+Krum rows are never clobbered.
+
+Run: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu nice -n 19 \
+       python tools/cell5_resume.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    from attacking_federate_learning_tpu.utils.backend import (
+        enable_compile_cache, ensure_live_backend
+    )
+
+    ensure_live_backend()
+    enable_compile_cache()
+
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.grid import run_grid
+
+    # Mirrors benchmarks.py _cells()[4] + the CPU-backend host opt-ins.
+    cfg = ExperimentConfig(
+        epochs=10, log_dir="logs", synth_train=4096, synth_test=512,
+        dataset=C.MNIST, users_count=10_000, mal_prop=0.24,
+        partition="dirichlet", batch_size=32,
+        data_placement="host_stream",
+        bulyan_selection_impl="host",
+        trimmed_mean_impl="host", bulyan_trim_impl="host")
+    t0 = time.time()
+    # Unique summary path per invocation: run_grid opens its out_path
+    # in 'w' mode, so a re-run after a mid-grid failure must not
+    # truncate the rows a previous invocation already banked.
+    out_path = time.strftime("logs/grid_summary_r5b_%H%M%S.jsonl")
+    cells = run_grid(cfg, defenses=["TrimmedMean", "Bulyan"],
+                     attacks=["alie", "backdoor"],
+                     out_path=out_path)
+    print(json.dumps({
+        "cell": "noniid_10k_grid_resume", "clients": cfg.users_count,
+        "wall_s": round(time.time() - t0, 2), "grid_cells": len(cells),
+        "final_accuracies": {f"{c['defense']}/{c['attack']}":
+                             c.get("final_accuracy") for c in cells}}))
+
+
+if __name__ == "__main__":
+    main()
