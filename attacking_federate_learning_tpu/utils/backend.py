@@ -55,15 +55,38 @@ def host_cache_fingerprint():
 
     bits = [platform.machine()]
     try:
+        wanted = ("flags", "Features", "model", "stepping", "bugs",
+                  "model name")
+        seen = set()
         with open("/proc/cpuinfo") as f:
             for line in f:
-                # One line suffices: all cores on a host report the same
-                # feature set ("flags" on x86, "Features" on arm).
-                if line.startswith(("flags", "Features")):
+                # One core suffices (all cores report the same); the
+                # feature flags alone do NOT discriminate the physical
+                # hosts behind this VM (observed: identical flags lines
+                # while XLA's AOT loader warned about foreign
+                # +prefer-no-scatter executables), so the model/
+                # stepping/bugs lines ride along.
+                key = line.split(":")[0].strip()
+                if key in wanted and key not in seen:
+                    seen.add(key)
                     bits.append(line.strip())
+                if len(seen) == len(wanted):
                     break
     except OSError:
         bits.append(platform.processor())
+    try:
+        # The strongest available proxy for the cpuid view the JIT's
+        # own host detection uses (and the piece /proc/cpuinfo masks on
+        # this VM): gcc's -march=native resolution enumerates every
+        # cpuid-detected target flag.  ~30 ms, once per process.
+        import subprocess
+        out = subprocess.run(
+            ["g++", "-march=native", "-Q", "--help=target"],
+            capture_output=True, timeout=10).stdout
+        bits.append(str(len(out)))
+        bits.append(out.decode("utf-8", "replace"))
+    except Exception:
+        pass
     try:
         # Version via metadata, NOT `import jax`: callers (conftest)
         # need the fingerprint before jax is imported, because jax 0.9
