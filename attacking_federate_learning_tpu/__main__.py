@@ -1,4 +1,8 @@
-"""``python -m attacking_federate_learning_tpu`` runs the experiment CLI."""
+"""``python -m attacking_federate_learning_tpu`` runs the experiment CLI.
+
+``python -m attacking_federate_learning_tpu report logs/run.jsonl``
+dispatches to the run-report tool (report.py) via the same entry point.
+"""
 
 from attacking_federate_learning_tpu.cli import main
 

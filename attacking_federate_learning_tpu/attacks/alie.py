@@ -13,6 +13,8 @@ from __future__ import annotations
 
 from statistics import NormalDist
 
+import jax.numpy as jnp
+
 from attacking_federate_learning_tpu.attacks.base import Attack, cohort_stats
 
 
@@ -49,3 +51,19 @@ class DriftAttack(Attack):
     def craft(self, mal_grads, ctx=None):
         mean, stdev = cohort_stats(mal_grads)
         return mean - self.num_std * stdev
+
+    def envelope_stats(self, users_grads, corrupted_count, ctx=None):
+        """z-bound envelope telemetry: the cohort mean/sigma norms and
+        the drift magnitude ``||z*sigma||`` — how far the crafted vector
+        sits from the honest mean, in the same units a clip envelope
+        (backdoor.py) or a trimming defense measures it."""
+        f = corrupted_count
+        if f == 0 or self.num_std == 0:
+            return {}
+        mean, stdev = cohort_stats(users_grads[:f])
+        sigma_norm = jnp.linalg.norm(stdev)
+        return {"z": jnp.asarray(self.num_std, jnp.float32),
+                "mean_norm": jnp.linalg.norm(mean),
+                "sigma_norm": sigma_norm,
+                "drift_norm": jnp.asarray(self.num_std,
+                                          jnp.float32) * sigma_norm}

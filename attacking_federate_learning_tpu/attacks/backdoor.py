@@ -183,6 +183,23 @@ class BackdoorAttack(Attack):
                     "Got nan in backdoor shadow training")
         return out
 
+    def envelope_stats(self, users_grads, corrupted_count, ctx=None):
+        """Telemetry: the ALIE clip envelope the crafted gradient is
+        laundered through (``||z*sigma||`` halfwidth) plus the shadow
+        objective's state — poison-set loss/accuracy of the CURRENT
+        global weights (when did the backdoor embed?).  Pure jitted jax,
+        so the fused round program carries it without a host hop."""
+        f = corrupted_count
+        if f == 0 or self.num_std == 0:
+            return {}
+        _, stdev = cohort_stats(users_grads[:f])
+        loss, correct = self._poison_metrics(ctx.original_params)
+        return {"z": jnp.asarray(self.num_std, jnp.float32),
+                "clip_halfwidth_norm": jnp.asarray(
+                    self.num_std, jnp.float32) * jnp.linalg.norm(stdev),
+                "shadow_loss": loss,
+                "poison_acc": 100.0 * correct / self.poison_count}
+
     def test_asr(self, flat_w, logger=None, tag="POST"):
         """Attack success rate of the *server* weights on the poisoned set
         (reference main.py:91-95 + backdoor.py:67-102); log line format

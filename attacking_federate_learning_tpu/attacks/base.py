@@ -64,6 +64,16 @@ class Attack:
         crafted = self.craft(users_grads[:f], ctx)
         return users_grads.at[:f].set(crafted[None, :])
 
+    def envelope_stats(self, users_grads, corrupted_count: int,
+                       ctx: Optional[AttackContext] = None) -> dict:
+        """Telemetry seam (core/engine.py, cfg.telemetry): fixed-shape,
+        device-side stats of the attack's crafting envelope, computed on
+        the PRE-attack gradient matrix — the same honest malicious-cohort
+        view ``craft`` derives its statistics from.  Must stay pure jax
+        (it runs inside the fused round program; no host callbacks).
+        Default: nothing to report."""
+        return {}
+
 
 class NoAttack(Attack):
     name = "none"

@@ -9,6 +9,11 @@ alias for --users-count (main.py:118), plus the TPU-era knobs: --backend,
 
 Run:  python -m attacking_federate_learning_tpu.cli -d Krum -s MNIST
 
+Subcommand: ``... cli report logs/run.jsonl [more.jsonl]`` summarizes
+structured run logs (selection concentration, phase timing, trajectories
+— report.py).  Dispatched before argparse so the experiment flag surface
+stays reference-verbatim.
+
 Heavy imports happen inside main() so --backend can select the JAX platform
 before jax initializes.
 """
@@ -228,6 +233,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--round-stats", action="store_true",
                    help="record per-round gradient/update norm diagnostics "
                         "in the JSONL log")
+    p.add_argument("--telemetry", action="store_true",
+                   help="per-round aggregation forensics: defense "
+                        "selection masks/scores, trim/clip/trust "
+                        "diagnostics, attack envelope stats, per-client "
+                        "norms — device-side aux outputs of the jitted "
+                        "round, written as 'defense'/'attack'/"
+                        "'selection_hist' events (read with the 'report' "
+                        "subcommand)")
     p.add_argument("--trace-dir", type=str, default=None,
                    help="capture a jax.profiler XLA trace into this dir")
     return p
@@ -272,6 +285,7 @@ def config_from_args(args) -> ExperimentConfig:
         bulyan_trim_impl=args.bulyan_trim_impl,
         server_uses_faded_lr=args.server_uses_faded_lr,
         log_round_stats=args.round_stats,
+        telemetry=args.telemetry,
         synth_train=args.synth_train,
         synth_test=args.synth_test,
         data_augment={"auto": None, "on": True, "off": False}[args.augment],
@@ -308,6 +322,17 @@ def apply_backend(backend: str):
 
 
 def main(argv=None):
+    if argv is None:
+        import sys
+
+        argv = sys.argv[1:]
+    if argv and argv[0] == "report":
+        # Run-report subcommand (report.py): pure log reading, no jax —
+        # dispatched before argparse so the experiment flag surface
+        # stays reference-verbatim.
+        from attacking_federate_learning_tpu.report import main as report_main
+
+        return report_main(argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.attack == "backdoor" and args.backdoor == "No":
@@ -336,51 +361,56 @@ def main(argv=None):
         PhaseTimer, xla_trace
     )
 
-    logger = RunLogger(cfg, cfg.output, cfg.log_dir)
-    logger.dump_config()
+    # Context-managed: the JSONL handle is closed and the accuracy CSV
+    # written even when the run raises (utils/metrics.py:RunLogger).
+    with RunLogger(cfg, cfg.output, cfg.log_dir) as logger:
+        logger.dump_config()
 
-    dataset = load_dataset(cfg.dataset, cfg.data_dir, cfg.seed,
-                           synth_train=cfg.synth_train,
-                           synth_test=cfg.synth_test)
-    attacker = make_attacker(cfg, dataset=dataset,
-                             name=None if args.attack == "auto"
-                             else args.attack)
-    exp = FederatedExperiment(cfg, attacker=attacker, dataset=dataset)
-    checkpointer = None if args.no_checkpoint else Checkpointer(cfg)
-    if args.resume is not None:
-        import numpy as np
+        dataset = load_dataset(cfg.dataset, cfg.data_dir, cfg.seed,
+                               synth_train=cfg.synth_train,
+                               synth_test=cfg.synth_test)
+        attacker = make_attacker(cfg, dataset=dataset,
+                                 name=None if args.attack == "auto"
+                                 else args.attack)
+        exp = FederatedExperiment(cfg, attacker=attacker, dataset=dataset)
+        checkpointer = None if args.no_checkpoint else Checkpointer(cfg)
+        if args.resume is not None:
+            import numpy as np
 
-        ckpt = checkpointer or Checkpointer(cfg)
-        path = args.resume if args.resume != "auto" else ckpt.path
-        if not os.path.exists(path):
-            raise SystemExit(f"--resume: no checkpoint at {path}")
-        if path.endswith((".pth.tar", ".pth", ".pt")):
-            # Reference-produced torch checkpoint (reference server.py:40-48).
-            from attacking_federate_learning_tpu.utils.checkpoint import (
-                import_reference_checkpoint
-            )
-            exp.state, ref_acc = import_reference_checkpoint(
-                path, expected_dim=exp.flat.dim)
-            if checkpointer is not None:
-                checkpointer.best_acc = ref_acc
-            logger.print(f"Imported reference checkpoint (acc {ref_acc})")
-        else:
-            exp.state = ckpt.resume(path)
-            if checkpointer is not None:
-                # Don't let the first post-resume eval overwrite a better
-                # checkpoint (keep_best seeding).
-                checkpointer.best_acc = float(np.load(path)["accuracy"])
-        if exp.shardings is not None:
-            # Restore the planned state sharding the engine set at init
-            # (state only — data placement was already decided at init,
-            # incl. the host-streaming keep-on-host contract).
-            exp.state = exp.shardings.place_state(exp.state)
-        logger.print(f"Resumed from round {int(exp.state.round)}")
-    timer = PhaseTimer() if args.profile else None
-    with xla_trace(args.trace_dir):
-        result = exp.run(logger, checkpointer=checkpointer, timer=timer)
-    if timer is not None:
-        logger.print({"phase_timing": timer.summary()})
+            ckpt = checkpointer or Checkpointer(cfg)
+            path = args.resume if args.resume != "auto" else ckpt.path
+            if not os.path.exists(path):
+                raise SystemExit(f"--resume: no checkpoint at {path}")
+            if path.endswith((".pth.tar", ".pth", ".pt")):
+                # Reference-produced torch checkpoint (reference
+                # server.py:40-48).
+                from attacking_federate_learning_tpu.utils.checkpoint import (
+                    import_reference_checkpoint
+                )
+                exp.state, ref_acc = import_reference_checkpoint(
+                    path, expected_dim=exp.flat.dim)
+                if checkpointer is not None:
+                    checkpointer.best_acc = ref_acc
+                logger.print(f"Imported reference checkpoint (acc {ref_acc})")
+            else:
+                exp.state = ckpt.resume(path)
+                if checkpointer is not None:
+                    # Don't let the first post-resume eval overwrite a
+                    # better checkpoint (keep_best seeding).
+                    checkpointer.best_acc = float(np.load(path)["accuracy"])
+            if exp.shardings is not None:
+                # Restore the planned state sharding the engine set at init
+                # (state only — data placement was already decided at init,
+                # incl. the host-streaming keep-on-host contract).
+                exp.state = exp.shardings.place_state(exp.state)
+            logger.print(f"Resumed from round {int(exp.state.round)}")
+        timer = PhaseTimer() if args.profile else None
+        with xla_trace(args.trace_dir):
+            result = exp.run(logger, checkpointer=checkpointer, timer=timer)
+        if timer is not None:
+            # finish() (run's success path) leaves the tee open for
+            # exactly this trailing summary; __exit__ closes it.
+            logger.print({"phase_timing": timer.summary()})
     return result
 
 

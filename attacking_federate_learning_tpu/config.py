@@ -249,6 +249,18 @@ class ExperimentConfig:
     # norm, faded lr) written to the JSONL log.  The reference logs only
     # eval-time accuracy (SURVEY.md §5).
     log_round_stats: bool = False
+    # Aggregation forensics (utils/metrics.py event schema): defenses
+    # return their fixed-shape diagnostics pytrees (Krum/Bulyan selection
+    # masks + scores, trim fractions, clip counts, FLTrust trust scores;
+    # defenses/kernels.py telemetry seam), attacks their envelope stats
+    # (ALIE z-bounds, backdoor shadow loss; attacks/base.py
+    # envelope_stats), plus per-client norms and cosine-to-mean — all
+    # carried as auxiliary outputs of the jitted round, stacked across
+    # rounds and fetched once per eval interval (NO host callbacks
+    # inside the jit), then written as 'defense'/'attack'/
+    # 'selection_hist' events.  Off by default: the compiled round
+    # program is bit-identical to the pre-telemetry one.
+    telemetry: bool = False
 
     def __post_init__(self):
         if self.model is not None and self.model in MODEL_FAMILY:

@@ -17,6 +17,17 @@ host nan guard.
 
 Evaluation, checkpointing and logging stay on the host at TEST_STEP cadence
 (reference main.py:73-95).
+
+Telemetry (cfg.telemetry): each round's defense diagnostics
+(defenses/kernels.py telemetry seam), attack envelope stats
+(attacks/base.py:envelope_stats) and per-client population stats ride out
+of the jitted round as AUXILIARY OUTPUTS — fixed-shape device pytrees, no
+host callbacks inside the jit.  When rounds fuse into spans, a
+``lax.scan`` stacks the per-round pytrees along a leading round axis and
+the host fetches the whole stack once per eval interval
+(``_tele_span``); the per-round dispatch modes fetch per round.  Events
+land in the run JSONL as 'defense'/'attack' records plus one end-of-run
+'selection_hist' (utils/metrics.py schema).
 """
 
 from __future__ import annotations
@@ -49,6 +60,15 @@ from attacking_federate_learning_tpu.defenses import (
 from attacking_federate_learning_tpu.models.base import get_model
 from attacking_federate_learning_tpu.utils.flatten import make_flattener
 from attacking_federate_learning_tpu.utils.metrics import RunLogger
+
+
+def _jsonable(v):
+    """Host telemetry leaf -> JSON value: 0-d arrays to float, vectors
+    to lists (the event schema stores fixed-shape vectors inline)."""
+    a = np.asarray(v)
+    if a.ndim == 0:
+        return float(a)
+    return [float(x) for x in a]
 
 
 class FederatedExperiment:
@@ -91,6 +111,8 @@ class FederatedExperiment:
             shardings = make_plan(tuple(cfg.mesh_shape))
         self.shardings = shardings  # parallel.MeshPlan or None (single device)
         self._krum_select_fn = None  # set for Krum (selection telemetry)
+        self.last_round_telemetry = None   # cfg.telemetry, per-round modes
+        self.last_span_telemetry = None    # cfg.telemetry, fused spans
         self.defense_fn = DEFENSES[cfg.defense]
         if cfg.defense in ("Krum", "Bulyan"):
             self.defense_fn = self._wire_distance_defense(self.defense_fn)
@@ -426,10 +448,14 @@ class FederatedExperiment:
             grads = self.shardings.constrain_grads(grads)
         return grads
 
-    def _aggregate_impl(self, state: ServerState, grads, t, agg=None):
+    def _aggregate_impl(self, state: ServerState, grads, t, agg=None,
+                        telemetry=False):
         """``agg`` pre-empts the defense call — the Krum-telemetry round
         computes the selection once and aggregates ``grads[sel]`` rather
-        than running the O(n^2 d) distance engine twice."""
+        than running the O(n^2 d) distance engine twice.  ``telemetry``
+        (static bool) asks the defense for its diagnostics pytree and
+        returns ``(new_state, diag)`` instead of ``new_state``."""
+        ddiag = {}
         if agg is None:
             kw = {}
             if getattr(self.defense_fn, "needs_round", False):
@@ -440,7 +466,11 @@ class FederatedExperiment:
                 server_grad = jax.grad(make_loss_fn(self.model, self.flat))(
                     state.weights, self._meta_x, self._meta_y)
                 kw["server_grad"] = server_grad
-            agg = self.defense_fn(grads, self.m, self.m_mal, **kw)
+            if telemetry:
+                agg, ddiag = self.defense_fn(grads, self.m, self.m_mal,
+                                             telemetry=True, **kw)
+            else:
+                agg = self.defense_fn(grads, self.m, self.m_mal, **kw)
         agg = agg.astype(jnp.float32)
         if self.cfg.server_uses_faded_lr:
             lr = faded_learning_rate(self.cfg.learning_rate,
@@ -449,7 +479,10 @@ class FederatedExperiment:
             # Reference parity: constant base lr on the server
             # (server.py:89, SURVEY.md §2.4 #7).
             lr = self.cfg.learning_rate
-        return momentum_update(state, agg, lr, self.cfg.momentum)
+        new_state = momentum_update(state, agg, lr, self.cfg.momentum)
+        if telemetry:
+            return new_state, ddiag
+        return new_state
 
     def _build_round_fns(self):
         cfg = self.cfg
@@ -504,35 +537,71 @@ class FederatedExperiment:
 
         # Selection telemetry: compute the Krum winner ONCE and aggregate
         # grads[sel] (krum == grads[krum_select], defenses/kernels.py) —
-        # the O(n^2 d) distance engine never runs twice per round.
-        diag_select = (self._krum_select_fn if cfg.log_round_stats
+        # the O(n^2 d) distance engine never runs twice per round.  With
+        # full telemetry on, the defense itself returns its selection
+        # mask from the same single distance computation, so the
+        # pre-emption is unnecessary there.
+        diag_select = (self._krum_select_fn
+                       if cfg.log_round_stats and not cfg.telemetry
                        else None)
+
+        def attack_envelope(grads, state, t):
+            """Pre-attack envelope stats (attacks/base.py seam), keyed
+            ``attack_*`` into the telemetry pytree."""
+            stats = self.attacker.envelope_stats(grads, self.m_mal,
+                                                 ctx_for(state, t))
+            return {"attack_" + k: v for k, v in stats.items()}
+
+        def finish_telemetry(tele, grads, ddiag):
+            """Merge defense diagnostics + population stats into the
+            round's telemetry pytree (all fixed-shape device arrays)."""
+            from attacking_federate_learning_tpu.defenses.kernels import (
+                population_telemetry
+            )
+            for k, v in ddiag.items():
+                tele["defense_" + k] = v
+            tele.update(population_telemetry(grads))
+            return tele
 
         if getattr(self.attacker, "fusable", True):
             def fused_core(state, t, batches=None):
                 grads = self._compute_grads_impl(state, t, batches)
+                tele = (attack_envelope(grads, state, t) if cfg.telemetry
+                        else {})
                 grads = self.attacker.apply(grads, self.m_mal,
                                             ctx_for(state, t))
                 aux = {}
-                agg = None
-                if diag_select is not None:
-                    sel = diag_select(grads, self.m, self.m_mal)
-                    aux["krum_selected"] = sel
-                    agg = grads[sel]
-                new_state = self._aggregate_impl(state, grads, t, agg=agg)
-                return new_state, grads, aux
+                if cfg.telemetry:
+                    new_state, ddiag = self._aggregate_impl(
+                        state, grads, t, telemetry=True)
+                    tele = finish_telemetry(tele, grads, ddiag)
+                    if (self._krum_select_fn is not None
+                            and "selection_mask" in ddiag):
+                        # Krum's mask is one-hot: its argmax IS the
+                        # aggregated row (defenses/kernels.py:krum).
+                        aux["krum_selected"] = jnp.argmax(
+                            ddiag["selection_mask"]).astype(jnp.int32)
+                else:
+                    agg = None
+                    if diag_select is not None:
+                        sel = diag_select(grads, self.m, self.m_mal)
+                        aux["krum_selected"] = sel
+                        agg = grads[sel]
+                    new_state = self._aggregate_impl(state, grads, t,
+                                                     agg=agg)
+                return new_state, grads, aux, tele
 
             def crafted_nonfinite(grads):
                 return (~jnp.isfinite(
                     grads[: self.m_mal].astype(jnp.float32))).any()
 
             def fused(state, t, batches=None):
-                new_state, grads, aux = fused_core(state, t, batches)
+                new_state, grads, aux, tele = fused_core(state, t, batches)
                 diag = (round_diagnostics(grads, new_state, t, aux)
                         if cfg.log_round_stats else {})
                 bad = (crafted_nonfinite(grads) if self._check_attack_nan
                        else jnp.asarray(False))
-                return new_state, diag, bad
+                return new_state, diag, bad, tele
 
             def fused_span(state, t0, count):
                 # One device program for `count` rounds: steady-state
@@ -542,7 +611,7 @@ class FederatedExperiment:
                 # so every span length shares one compilation.
                 def body(i, carry):
                     s, bad = carry
-                    s2, grads, _ = fused_core(s, t0 + i)
+                    s2, grads, _, _ = fused_core(s, t0 + i)
                     if self._check_attack_nan:
                         bad = bad | crafted_nonfinite(grads)
                     return s2, bad
@@ -550,8 +619,29 @@ class FederatedExperiment:
                 return jax.lax.fori_loop(0, count, body,
                                          (state, jnp.asarray(False)))
 
+            def tele_span(state, t0, count):
+                # Telemetry span: lax.scan stacks each round's telemetry
+                # pytree along a leading round axis, so `count` rounds
+                # still run as ONE device program and the host fetches
+                # the stack once per eval interval — no callbacks inside
+                # the jit.  The stacked output's leading dim forces
+                # `count` static (one compilation per distinct span
+                # length; the eval cadence yields at most two).
+                def body(carry, i):
+                    s, bad = carry
+                    s2, grads, _, tele = fused_core(s, t0 + i)
+                    if self._check_attack_nan:
+                        bad = bad | crafted_nonfinite(grads)
+                    return (s2, bad), tele
+
+                (s, bad), stacked = jax.lax.scan(
+                    body, (state, jnp.asarray(False)), jnp.arange(count))
+                return s, bad, stacked
+
             self._fused_round = jax.jit(fused, donate_argnums=0)
             self._fused_span = jax.jit(fused_span, donate_argnums=0)
+            self._tele_span = jax.jit(tele_span, static_argnums=2,
+                                      donate_argnums=0)
             self._staged = False
         else:
             self._compute_grads = jax.jit(self._compute_grads_impl)
@@ -571,7 +661,17 @@ class FederatedExperiment:
             self._aggregate = (self._aggregate_impl if eager_host_agg
                                else jax.jit(self._aggregate_impl,
                                             donate_argnums=0))
+            if cfg.telemetry:
+                # telemetry is a trace-time (static) flag, so the
+                # telemetry aggregate is its own jitted function.
+                agg_tele = functools.partial(self._aggregate_impl,
+                                             telemetry=True)
+                self._aggregate_tele = (agg_tele if eager_host_agg
+                                        else jax.jit(agg_tele,
+                                                     donate_argnums=0))
             self._staged = True
+        self._attack_envelope = attack_envelope
+        self._finish_telemetry = finish_telemetry
 
     # ------------------------------------------------------------------
     def _raise_if_attack_nan(self, bad):
@@ -590,7 +690,10 @@ class FederatedExperiment:
         otherwise (staged attacks need host crafting; round diagnostics
         need every intermediate gradient matrix; host-streamed data feeds
         one round's batch per program, overlapped with the previous
-        round's compute)."""
+        round's compute).  Under cfg.telemetry the span still runs as one
+        program — per-round telemetry pytrees come back STACKED
+        (``_tele_span``) and land in ``self.last_span_telemetry`` as
+        ``(start, stacked_pytree)`` for the caller to fetch once."""
         if count <= 0:
             return self.state
         if self._staged or self.cfg.log_round_stats or self._streaming:
@@ -598,6 +701,7 @@ class FederatedExperiment:
                 self.run_round(t)
         else:
             self.last_round_stats = None
+            self.last_span_telemetry = None
             pre_span = None
             if self._check_attack_nan:
                 # The span donates self.state, so when the in-program nan
@@ -607,9 +711,14 @@ class FederatedExperiment:
                 # the pre-span state (~2 vectors of d) keeps catch-and-
                 # continue callers (benchmarks.py) recoverable.
                 pre_span = jax.tree.map(np.asarray, self.state)
-            self.state, bad = self._fused_span(
-                self.state, jnp.asarray(start, jnp.int32),
-                jnp.asarray(count, jnp.int32))
+            if self.cfg.telemetry:
+                self.state, bad, stacked = self._tele_span(
+                    self.state, jnp.asarray(start, jnp.int32), int(count))
+                self.last_span_telemetry = (int(start), stacked)
+            else:
+                self.state, bad = self._fused_span(
+                    self.state, jnp.asarray(start, jnp.int32),
+                    jnp.asarray(count, jnp.int32))
             if self._check_attack_nan and bool(bad):
                 self.state = (self.shardings.place_state(pre_span)
                               if self.shardings is not None
@@ -621,30 +730,93 @@ class FederatedExperiment:
         batches = self.stream.get(int(t)) if self._streaming else None
         t = jnp.asarray(t, jnp.int32)
         self.last_round_stats = None
+        self.last_round_telemetry = None
         if not self._staged:
-            self.state, diag, bad = self._fused_round(self.state, t,
-                                                      batches)
+            self.state, diag, bad, tele = self._fused_round(self.state, t,
+                                                            batches)
             if diag:
                 self.last_round_stats = diag
+            if tele:
+                self.last_round_telemetry = tele
             self._raise_if_attack_nan(bad)
         else:
             grads = self._compute_grads(self.state, t, batches)
+            tele = (self._attack_envelope(grads, self.state, t)
+                    if self.cfg.telemetry else {})
             grads = self.attacker.apply(grads, self.m_mal,
                                         self._ctx_for(self.state, t))
             aux = {}
-            agg = None
-            if self.cfg.log_round_stats and self._krum_select_fn is not None:
-                # Eager selection (same knobs as the defense), aggregate
-                # the selected row directly — single distance computation,
-                # same as the fused path.
-                sel = self._krum_select_fn(grads, self.m, self.m_mal)
-                aux["krum_selected"] = sel
-                agg = grads[sel]
-            self.state = self._aggregate(self.state, grads, t, agg)
+            if self.cfg.telemetry:
+                # The defense returns its own diagnostics (single
+                # distance computation; the Krum mask marks the
+                # aggregated row by construction).
+                self.state, ddiag = self._aggregate_tele(self.state,
+                                                         grads, t)
+                tele = self._finish_telemetry(tele, grads, ddiag)
+                if (self._krum_select_fn is not None
+                        and "selection_mask" in ddiag):
+                    aux["krum_selected"] = jnp.argmax(
+                        ddiag["selection_mask"]).astype(jnp.int32)
+                self.last_round_telemetry = tele
+            else:
+                agg = None
+                if (self.cfg.log_round_stats
+                        and self._krum_select_fn is not None):
+                    # Eager selection (same knobs as the defense),
+                    # aggregate the selected row directly — single
+                    # distance computation, same as the fused path.
+                    sel = self._krum_select_fn(grads, self.m, self.m_mal)
+                    aux["krum_selected"] = sel
+                    agg = grads[sel]
+                self.state = self._aggregate(self.state, grads, t, agg)
             if self.cfg.log_round_stats:
                 self.last_round_stats = self._round_diagnostics(
                     grads, self.state, t, aux)
         return self.state
+
+    def _emit_round_telemetry(self, logger, t, tele):
+        """Write one round's telemetry (host values) as 'defense' and
+        'attack' events; track Krum winners for the end-of-run
+        selection histogram."""
+        defense_fields, attack_fields = {}, {}
+        for k, v in tele.items():
+            val = _jsonable(v)
+            if k.startswith("attack_"):
+                attack_fields[k[len("attack_"):]] = val
+            elif k.startswith("defense_"):
+                defense_fields[k[len("defense_"):]] = val
+            else:
+                defense_fields[k] = val  # population stats
+        logger.record(kind="defense", round=int(t),
+                      defense=self.cfg.defense,
+                      malicious_count=self.m_mal, **defense_fields)
+        if attack_fields:
+            logger.record(kind="attack", round=int(t),
+                          attack=self.attacker.name, **attack_fields)
+        mask = defense_fields.get("selection_mask")
+        if mask is not None and self._krum_select_fn is not None:
+            # Krum: one-hot mask -> winner id for the selection histogram.
+            self._telemetry_winners.append(
+                int(max(range(len(mask)), key=mask.__getitem__)))
+
+    def _emit_selection_hist(self, logger):
+        """End-of-run 'selection_hist' event: the GRID_RESULTS top-1-
+        share analysis, emitted by the engine instead of hand-rolled
+        drivers (tools/femnist_style_study.py pre-telemetry)."""
+        import collections
+
+        wins = self._telemetry_winners
+        if not wins:
+            return
+        counts = collections.Counter(wins)
+        top1_client, top1 = counts.most_common(1)[0]
+        logger.record(
+            kind="selection_hist", defense=self.cfg.defense,
+            counts={str(k): v for k, v in sorted(counts.items())},
+            rounds=len(wins), distinct_winners=len(counts),
+            top1_share=round(top1 / len(wins), 4),
+            top1_client=top1_client,
+            malicious_picks=sum(1 for w in wins if w < self.m_mal))
 
     def run(self, logger: Optional[RunLogger] = None,
             checkpointer=None, timer=None) -> dict:
@@ -653,12 +825,20 @@ class FederatedExperiment:
         ``timer``: an optional utils.profiling.PhaseTimer; per-phase
         wall-clock (round / eval, device-synchronized) is accumulated and
         written as a structured record at the end (the reference's only
-        timing artifact is one timestamp, main.py:97)."""
+        timing artifact is one timestamp, main.py:97).
+
+        Logger ownership: a logger the engine creates itself is managed
+        with ``with`` (crash-safe close — JSONL handle closed, accuracy
+        CSV written even if the loop raises); a caller-provided logger is
+        ``finish()``ed on success as before, and the caller's own
+        ``with`` (cli.py) covers the crash path."""
         import contextlib
 
         cfg = self.cfg
+        own_logger = logger is None
         logger = logger or RunLogger(cfg, cfg.output, cfg.log_dir)
         test_size = len(self.dataset.test_y)
+        self._telemetry_winners = []
 
         def phase(name, sync=None):
             if timer is None:
@@ -666,6 +846,14 @@ class FederatedExperiment:
             return timer.phase(name,
                                sync_on=sync or (lambda: self.state.weights))
 
+        with contextlib.ExitStack() as stack:
+            if own_logger:
+                stack.enter_context(logger)
+            return self._run_body(logger, checkpointer, timer, phase,
+                                  test_size)
+
+    def _run_body(self, logger, checkpointer, timer, phase, test_size):
+        cfg = self.cfg
         if cfg.backdoor:
             # Pre-training accuracy line (reference main.py:45-51).
             loss0, correct0 = self.evaluate(self.state.weights)
@@ -693,6 +881,16 @@ class FederatedExperiment:
                     boundary = min((epoch // cfg.test_step + 1)
                                    * cfg.test_step, cfg.epochs - 1)
                 self.run_span(epoch, boundary - epoch + 1)
+                if cfg.telemetry and self.last_span_telemetry is not None:
+                    # ONE host fetch per eval interval: the whole stacked
+                    # telemetry pytree comes over at the eval boundary.
+                    t0, stacked = self.last_span_telemetry
+                    host = jax.tree.map(np.asarray, stacked)
+                    for i in range(boundary - epoch + 1):
+                        self._emit_round_telemetry(
+                            logger, t0 + i,
+                            jax.tree.map(lambda a: a[i], host))
+                    self.last_span_telemetry = None
                 epoch = boundary
             else:
                 with phase("round"):
@@ -701,6 +899,11 @@ class FederatedExperiment:
                     logger.record(kind="round", round=epoch,
                                   **{k: float(v) for k, v in
                                      self.last_round_stats.items()})
+                if cfg.telemetry and self.last_round_telemetry is not None:
+                    self._emit_round_telemetry(
+                        logger, epoch,
+                        jax.tree.map(np.asarray,
+                                     self.last_round_telemetry))
 
             if epoch % cfg.test_step == 0 or epoch == cfg.epochs - 1:
                 # The lambda reads `correct` after the block assigns it, so
@@ -721,6 +924,8 @@ class FederatedExperiment:
                                   attack_success_rate=float(asr))
             epoch += 1
 
+        if self.cfg.telemetry:
+            self._emit_selection_hist(logger)
         if timer is not None:
             logger.record(kind="profile", phases=timer.summary())
         if self._streaming:

@@ -32,7 +32,10 @@ from attacking_federate_learning_tpu.defenses.kernels import DEFENSES
 
 @DEFENSES.register("CenteredClip")
 def centered_clip(users_grads, users_count, corrupted_count,
-                  tau=10.0, iters=5):
+                  tau=10.0, iters=5, telemetry=False):
+    """``telemetry=True`` additionally returns ``{'clip_scale': (n,) —
+    each client's clip factor wrt the returned estimate (1.0 = inside
+    the tau ball), 'clipped_count': () int32 rows strictly clipped}``."""
     G = users_grads.astype(jnp.float32)
     v0 = jnp.median(G, axis=0)
 
@@ -42,4 +45,10 @@ def centered_clip(users_grads, users_count, corrupted_count,
         scale = jnp.minimum(1.0, tau / jnp.maximum(norms, 1e-12))
         return v + jnp.mean(diff * scale[:, None], axis=0)
 
-    return lax.fori_loop(0, iters, body, v0)
+    v = lax.fori_loop(0, iters, body, v0)
+    if not telemetry:
+        return v
+    norms = jnp.linalg.norm(G - v[None, :], axis=1)
+    scale = jnp.minimum(1.0, tau / jnp.maximum(norms, 1e-12))
+    return v, {"clip_scale": scale,
+               "clipped_count": jnp.sum(scale < 1.0).astype(jnp.int32)}

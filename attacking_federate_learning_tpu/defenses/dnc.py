@@ -54,14 +54,21 @@ def _top_direction(Sc, key):
 @DEFENSES.register("DnC")
 def dnc(users_grads, users_count, corrupted_count, n_iters: int = _N_ITERS,
         filter_frac: float = _FILTER_FRAC, sketch_dim: int = _SKETCH_DIM,
-        seed: int = 0, round=0):
+        seed: int = 0, round=0, telemetry=False):
+    """``telemetry=True`` additionally returns ``{'survivor_mask': (n,)
+    f32 0/1 — clients no iteration marked as outliers, 'survivor_count':
+    () int32}``."""
     G = users_grads.astype(jnp.float32)
     n, d = G.shape
     # Outliers removed per iteration; capped so at least one client can
     # survive every iteration.
     remove = min(int(filter_frac * corrupted_count), n - 1)
     if remove == 0:
-        return jnp.mean(G, axis=0)
+        agg = jnp.mean(G, axis=0)
+        if not telemetry:
+            return agg
+        return agg, {"survivor_mask": jnp.ones((n,), jnp.float32),
+                     "survivor_count": jnp.asarray(n, jnp.int32)}
     keep = n - remove
     r = min(sketch_dim, d)
     if r == d:
@@ -92,7 +99,11 @@ def dnc(users_grads, users_count, corrupted_count, n_iters: int = _N_ITERS,
     survivors = jnp.sum(w)
     survivor_mean = (w @ G) / jnp.maximum(survivors, 1.0)
     # Empty intersection (possible at small n): overall mean, not zeros.
-    return jnp.where(survivors > 0, survivor_mean, jnp.mean(G, axis=0))
+    agg = jnp.where(survivors > 0, survivor_mean, jnp.mean(G, axis=0))
+    if not telemetry:
+        return agg
+    return agg, {"survivor_mask": w,
+                 "survivor_count": survivors.astype(jnp.int32)}
 
 
 # Engine seam: pass the round index so sketches refresh every round

@@ -26,7 +26,12 @@ import jax.numpy as jnp
 from attacking_federate_learning_tpu.defenses.kernels import DEFENSES
 
 
-def fltrust(users_grads, users_count, corrupted_count, server_grad=None):
+def fltrust(users_grads, users_count, corrupted_count, server_grad=None,
+            telemetry=False):
+    """``telemetry=True`` additionally returns ``{'trust_scores': (n,)
+    relu-clipped trust weights, 'cosine': (n,) raw cosine to the server
+    gradient, 'server_grad_norm': ()}`` — the per-client trust the
+    weighted average actually used."""
     assert server_grad is not None, "FLTrust requires the server gradient"
     g0 = server_grad
     g0_norm = jnp.linalg.norm(g0)
@@ -35,7 +40,11 @@ def fltrust(users_grads, users_count, corrupted_count, server_grad=None):
     cos = (users_grads @ g0) / (gi_norm * g0_norm + eps)
     ts = jnp.maximum(cos, 0.0)                      # relu-clipped trust
     scaled = users_grads * (g0_norm / (gi_norm + eps))[:, None]
-    return (ts @ scaled) / (jnp.sum(ts) + eps)
+    agg = (ts @ scaled) / (jnp.sum(ts) + eps)
+    if not telemetry:
+        return agg
+    return agg, {"trust_scores": ts, "cosine": cos,
+                 "server_grad_norm": g0_norm}
 
 
 fltrust.needs_server_grad = True

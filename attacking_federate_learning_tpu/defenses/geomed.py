@@ -25,7 +25,11 @@ _EPS = 1e-6
 
 @DEFENSES.register("GeoMedian")
 def geometric_median(users_grads, users_count, corrupted_count,
-                     iters: int = _ITERS, eps: float = _EPS):
+                     iters: int = _ITERS, eps: float = _EPS,
+                     telemetry=False):
+    """``telemetry=True`` additionally returns ``{'dist_to_agg': (n,)}``
+    — each client's distance to the geometric median (the Weiszfeld
+    weights are 1/dist, so this is the influence view)."""
     G = users_grads.astype(jnp.float32)
 
     def step(_, z):
@@ -34,4 +38,7 @@ def geometric_median(users_grads, users_count, corrupted_count,
         return (w @ G) / jnp.sum(w)
 
     z0 = jnp.mean(G, axis=0)
-    return lax.fori_loop(0, iters, step, z0)
+    z = lax.fori_loop(0, iters, step, z0)
+    if not telemetry:
+        return z
+    return z, {"dist_to_agg": jnp.linalg.norm(G - z[None, :], axis=1)}

@@ -14,6 +14,20 @@ instead of Python loops:
   distance matrix with a boolean alive-mask, so shapes never change and jit
   compiles once.
 
+Telemetry seam: every registered defense accepts ``telemetry=False``.
+With it off (the default) the function returns the aggregated ``(d,)``
+vector through the exact pre-telemetry code path — same compiled HLO, bit
+for bit.  With it on it returns ``(aggregated, diagnostics)``, where the
+diagnostics are a SMALL, FIXED-SHAPE pytree of device arrays (selection
+masks and score vectors for Krum/Bulyan, per-client kept fractions for
+the trimmed mean, clip scales/counts, trust scores, ...) that the engine
+threads out of the fused round program as auxiliary jit outputs
+(core/engine.py) — never via host callbacks.  ``telemetry`` is a Python
+bool, so the branch resolves at trace time and the off path stays
+untouched.  Host-engine variants that only return an aggregate (no
+scores) fill their score slots with NaN — fixed shapes, explicit "not
+measured".
+
 Semantics match the reference's exact variants, quirks included
 (SURVEY.md §2.4 #4-6): Krum scores sum the (users_count - corrupted_count)
 *smallest* distances, not the paper's n-f-2 (reference defences.py:26,
@@ -116,10 +130,25 @@ def _host_defense(host_fn, users_grads, users_count, corrupted_count,
                              users_grads.astype(jnp.float32))
 
 
+def population_telemetry(users_grads):
+    """Per-client update norms and cosine-to-mean — the population view
+    the server can always observe (Bonawitz et al.: the update
+    population is the server's only defense signal), independent of
+    which defense runs.  Fixed shapes: two (n,) f32 vectors."""
+    G = users_grads.astype(jnp.float32)
+    norms = jnp.linalg.norm(G, axis=1)
+    mean = jnp.mean(G, axis=0)
+    cos = (G @ mean) / (norms * jnp.linalg.norm(mean) + 1e-12)
+    return {"client_norms": norms, "cosine_to_mean": cos}
+
+
 @DEFENSES.register("NoDefense")
-def no_defense(users_grads, users_count, corrupted_count):
+def no_defense(users_grads, users_count, corrupted_count, telemetry=False):
     """Plain FedAvg mean (reference defences.py:13-14)."""
-    return jnp.mean(users_grads, axis=0)
+    agg = jnp.mean(users_grads, axis=0)
+    if not telemetry:
+        return agg
+    return agg, {}
 
 
 def _krum_scores(D, users_count, corrupted_count, alive=None,
@@ -225,6 +254,25 @@ def _host_krum_index(users_grads, users_count, corrupted_count,
                              users_grads.astype(jnp.float32))
 
 
+def _krum_scores_and_index(users_grads, users_count, corrupted_count,
+                           paper_scoring, method, distance_impl, D,
+                           distance_dtype):
+    """(scores-or-None, winner index) behind both :func:`krum_select`
+    and the telemetry path.  Scores are ``None`` on the host engine —
+    it returns only the scalar index (defenses/host.py), so telemetry
+    fills that slot with NaN instead of paying a second (n,) marshal."""
+    if D is None:
+        impl = resolve_distance_impl(distance_impl, users_count,
+                                     users_grads)
+        if impl == "host":
+            return None, _host_krum_index(users_grads, users_count,
+                                          corrupted_count, paper_scoring)
+        D = _distances_for(users_grads, impl, distance_dtype)
+    scores = _krum_scores(D, users_count, corrupted_count,
+                          paper_scoring=paper_scoring, method=method)
+    return scores, jnp.argmin(scores)
+
+
 def krum_select(users_grads, users_count, corrupted_count,
                 paper_scoring=False, method="sort", distance_impl="xla",
                 D=None, distance_dtype=None):
@@ -232,21 +280,15 @@ def krum_select(users_grads, users_count, corrupted_count,
     defences.py:39-40).  :func:`krum` is defined through this, so the
     selection the engine's round diagnostics report is — by construction —
     the client the defense aggregated, for every distance engine."""
-    if D is None:
-        impl = resolve_distance_impl(distance_impl, users_count,
-                                     users_grads)
-        if impl == "host":
-            return _host_krum_index(users_grads, users_count,
-                                    corrupted_count, paper_scoring)
-        D = _distances_for(users_grads, impl, distance_dtype)
-    scores = _krum_scores(D, users_count, corrupted_count,
-                          paper_scoring=paper_scoring, method=method)
-    return jnp.argmin(scores)
+    return _krum_scores_and_index(users_grads, users_count, corrupted_count,
+                                  paper_scoring, method, distance_impl, D,
+                                  distance_dtype)[1]
 
 
 @DEFENSES.register("Krum")
 def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
-         method="sort", distance_impl="xla", D=None, distance_dtype=None):
+         method="sort", distance_impl="xla", D=None, distance_dtype=None,
+         telemetry=False):
     """Krum selection (reference defences.py:23-42): the single gradient
     whose summed distance to its k nearest peers is minimal.
 
@@ -257,16 +299,31 @@ def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
     with zero diagonal — the engine passes one from the blockwise shard_map
     kernels (parallel/distances.py) for distance_impl in {ring, allgather}.
     ``distance_dtype``: see :func:`_distances_for` (bf16 MXU mode).
+
+    ``telemetry=True`` additionally returns ``{'selection_mask': (n,)
+    one-hot f32, 'scores': (n,) f32 Krum scores}`` — the same single
+    distance computation, so the mask provably marks the aggregated row
+    (NaN scores on the scalar-index host engine).
     """
-    return users_grads[krum_select(users_grads, users_count,
-                                   corrupted_count,
-                                   paper_scoring=paper_scoring,
-                                   method=method,
-                                   distance_impl=distance_impl, D=D,
-                                   distance_dtype=distance_dtype)]
+    if not telemetry:
+        return users_grads[krum_select(users_grads, users_count,
+                                       corrupted_count,
+                                       paper_scoring=paper_scoring,
+                                       method=method,
+                                       distance_impl=distance_impl, D=D,
+                                       distance_dtype=distance_dtype)]
+    scores, idx = _krum_scores_and_index(
+        users_grads, users_count, corrupted_count, paper_scoring, method,
+        distance_impl, D, distance_dtype)
+    n = users_grads.shape[0]
+    scores_out = (jnp.full((n,), jnp.nan, jnp.float32) if scores is None
+                  else scores.astype(jnp.float32))
+    mask = jnp.zeros((n,), jnp.float32).at[idx].set(1.0)
+    return users_grads[idx], {"selection_mask": mask, "scores": scores_out}
 
 
-def trimmed_mean_of(users_grads, number_to_consider, impl="xla"):
+def trimmed_mean_of(users_grads, number_to_consider, impl="xla",
+                    telemetry=False):
     """Median-anchored trimmed mean along the client axis.
 
     Per coordinate (reference defences.py:48-51): subtract the median, keep
@@ -277,23 +334,43 @@ def trimmed_mean_of(users_grads, number_to_consider, impl="xla"):
     ``impl='host'`` is the single dispatch site for the native
     column-blocked kernel — shared by :func:`trimmed_mean` and Bulyan's
     ``trim_impl`` tail so the two can never diverge.
+
+    ``telemetry=True`` additionally returns ``{'kept_fraction': (n,) —
+    per client, the fraction of coordinates where its value survived the
+    trim (NaN on the host kernel, which returns only the aggregate) —
+    'trim_fraction': () — the per-round fraction of clients trimmed per
+    coordinate}``.
     """
+    n = users_grads.shape[0]
+    trim_frac = jnp.float32(1.0 - number_to_consider / n)
     if impl == "host":
         from attacking_federate_learning_tpu.defenses.host import (
             host_trimmed_mean_of
         )
         k_static = int(number_to_consider)
-        return host_coordwise(
+        agg = host_coordwise(
             lambda g: host_trimmed_mean_of(g, k_static), users_grads)
+        if not telemetry:
+            return agg
+        return agg, {"kept_fraction": jnp.full((n,), jnp.nan, jnp.float32),
+                     "trim_fraction": trim_frac}
     med = jnp.median(users_grads, axis=0)
     dev = users_grads - med[None, :]
     order = jnp.argsort(jnp.abs(dev), axis=0, stable=True)
-    kept = jnp.take_along_axis(dev, order[:number_to_consider], axis=0)
-    return jnp.mean(kept, axis=0) + med
+    kept_rows = order[:number_to_consider]
+    kept = jnp.take_along_axis(dev, kept_rows, axis=0)
+    agg = jnp.mean(kept, axis=0) + med
+    if not telemetry:
+        return agg
+    d = users_grads.shape[1]
+    kept_frac = (jnp.zeros((n,), jnp.float32)
+                 .at[kept_rows.reshape(-1)].add(1.0) / d)
+    return agg, {"kept_fraction": kept_frac, "trim_fraction": trim_frac}
 
 
 @DEFENSES.register("TrimmedMean")
-def trimmed_mean(users_grads, users_count, corrupted_count, impl="xla"):
+def trimmed_mean(users_grads, users_count, corrupted_count, impl="xla",
+                 telemetry=False):
     """Reference defences.py:44-52; keeps n - f - 1 coordinates.
 
     ``impl='host'`` (opt-in, config ``trimmed_mean_impl``) routes to the
@@ -307,7 +384,8 @@ def trimmed_mean(users_grads, users_count, corrupted_count, impl="xla"):
     (tests/test_engine.py::test_backdoor_fused_equals_staged) holds
     only when both modes run the same kernel."""
     number_to_consider = users_grads.shape[0] - corrupted_count - 1
-    return trimmed_mean_of(users_grads, number_to_consider, impl=impl)
+    return trimmed_mean_of(users_grads, number_to_consider, impl=impl,
+                           telemetry=telemetry)
 
 
 def host_coordwise(host_fn, users_grads):
@@ -361,10 +439,28 @@ def _host_bulyan_selection_of(D, users_count, corrupted_count, set_size,
                              D.astype(jnp.float32))
 
 
+def _bulyan_diag(n, selected, Dm, users_count, corrupted_count,
+                 paper_scoring, method):
+    """Bulyan telemetry pytree: the (n,) multi-hot selection mask plus
+    the INITIAL-pool Krum scores (the scores the first selection ranked;
+    later trips re-score over the shrinking pool, which would be an
+    (n, set_size) matrix — deliberately not carried).  ``Dm`` None (the
+    full-host engine, which only returns the aggregate) fills NaN."""
+    mask = jnp.zeros((n,), jnp.float32).at[selected].set(1.0)
+    if Dm is None:
+        scores = jnp.full((n,), jnp.nan, jnp.float32)
+    else:
+        scores = _krum_scores(Dm, users_count, corrupted_count,
+                              paper_scoring=paper_scoring,
+                              method=method).astype(jnp.float32)
+    return {"selection_mask": mask, "scores": scores}
+
+
 @DEFENSES.register("Bulyan")
 def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
            method="sort", distance_impl="xla", D=None, batch_select=1,
-           distance_dtype=None, selection_impl="xla", trim_impl="xla"):
+           distance_dtype=None, selection_impl="xla", trim_impl="xla",
+           telemetry=False):
     """Bulyan (reference defences.py:55-70): iteratively Krum-select
     n - 2f gradients (removing each winner from the pool, with the pool
     size — but not f — shrinking), then trim-mean the selection with
@@ -416,7 +512,10 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
     star the XLA:CPU stable argsort over the (n-2f, d) selection is
     minutes per aggregation while the native kernel is seconds, and on
     the CPU backend that tail, not the selection, is what dominates the
-    hybrid."""
+    hybrid.
+
+    ``telemetry=True`` additionally returns the :func:`_bulyan_diag`
+    pytree (multi-hot selection mask + initial-pool Krum scores)."""
     n, _ = users_grads.shape
     f = corrupted_count
     set_size = users_count - 2 * f
@@ -444,8 +543,15 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
             host_fn = host_bulyan
             if q > 1:
                 host_fn = functools.partial(host_bulyan, batch_select=q)
-            return _host_defense(host_fn, users_grads, users_count,
-                                 corrupted_count, paper_scoring)
+            agg = _host_defense(host_fn, users_grads, users_count,
+                                corrupted_count, paper_scoring)
+            if not telemetry:
+                return agg
+            # The full-host engine returns only the (d,) aggregate; the
+            # selection never crosses back.  NaN mask/scores keep the
+            # pytree shape fixed and say "not measured" explicitly.
+            nan = jnp.full((n,), jnp.nan, jnp.float32)
+            return agg, {"selection_mask": nan, "scores": nan}
         D = _distances_for(users_grads, impl, distance_dtype)
 
     # +inf diagonal reproduces the reference's no-self-distance dict
@@ -458,7 +564,11 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
         selected = _host_bulyan_selection_of(
             Dm, users_count, corrupted_count, set_size, q, paper_scoring)
         selection = users_grads[selected]
-        return trim_tail(selection, set_size - 2 * f - 1)
+        agg = trim_tail(selection, set_size - 2 * f - 1)
+        if not telemetry:
+            return agg
+        return agg, _bulyan_diag(n, selected, Dm, users_count,
+                                 corrupted_count, paper_scoring, method)
 
     # Presort once for the traced selection loop.
     order = jnp.argsort(Dm, axis=1)
@@ -492,7 +602,11 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
 
     selection = users_grads[selected]  # (set_size, d), in selection order
     number_to_consider = set_size - 2 * f - 1
-    return trim_tail(selection, number_to_consider)
+    agg = trim_tail(selection, number_to_consider)
+    if not telemetry:
+        return agg
+    return agg, _bulyan_diag(n, selected, Dm, users_count, corrupted_count,
+                             paper_scoring, method)
 
 
 def check_defense_args(name, users_count, corrupted_count):

@@ -14,11 +14,17 @@ from attacking_federate_learning_tpu.defenses.kernels import DEFENSES
 
 
 @DEFENSES.register("Median")
-def median(users_grads, users_count, corrupted_count, impl="xla"):
+def median(users_grads, users_count, corrupted_count, impl="xla",
+           telemetry=False):
     """``impl='host'`` (opt-in, config ``median_impl``) routes to the
     native column-blocked kernel (native/bulyan_select.cpp:fl_median) —
     same rationale and same non-auto-dispatch rule as
-    kernels.py:trimmed_mean."""
+    kernels.py:trimmed_mean.
+
+    ``telemetry=True`` additionally returns ``{'dist_to_agg': (n,)}`` —
+    each client's L2 distance to the aggregated median vector, the
+    outlier view a coordinate-wise estimator admits (both impls: the
+    distance is computed from the returned aggregate)."""
     if impl == "host":
         from attacking_federate_learning_tpu.defenses.host import (
             host_median
@@ -26,5 +32,11 @@ def median(users_grads, users_count, corrupted_count, impl="xla"):
         from attacking_federate_learning_tpu.defenses.kernels import (
             host_coordwise
         )
-        return host_coordwise(host_median, users_grads)
-    return jnp.median(users_grads, axis=0)
+        agg = host_coordwise(host_median, users_grads)
+    else:
+        agg = jnp.median(users_grads, axis=0)
+    if not telemetry:
+        return agg
+    G = users_grads.astype(jnp.float32)
+    dist = jnp.linalg.norm(G - agg.astype(jnp.float32)[None, :], axis=1)
+    return agg, {"dist_to_agg": dist}

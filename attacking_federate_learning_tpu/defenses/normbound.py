@@ -17,9 +17,18 @@ from attacking_federate_learning_tpu.defenses.kernels import DEFENSES
 
 
 @DEFENSES.register("NormBound")
-def norm_bounded_mean(users_grads, users_count, corrupted_count):
+def norm_bounded_mean(users_grads, users_count, corrupted_count,
+                      telemetry=False):
+    """``telemetry=True`` additionally returns ``{'clip_scale': (n,),
+    'clipped_count': () int32, 'norm_bound': () the cohort-median bound}``
+    — which clients the norm clip actually touched this round."""
     G = users_grads.astype(jnp.float32)
     norms = jnp.linalg.norm(G, axis=1)
     bound = jnp.median(norms)
     scale = jnp.minimum(1.0, bound / jnp.maximum(norms, 1e-12))
-    return jnp.mean(G * scale[:, None], axis=0)
+    agg = jnp.mean(G * scale[:, None], axis=0)
+    if not telemetry:
+        return agg
+    return agg, {"clip_scale": scale,
+                 "clipped_count": jnp.sum(scale < 1.0).astype(jnp.int32),
+                 "norm_bound": bound}

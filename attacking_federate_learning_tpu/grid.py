@@ -78,10 +78,12 @@ def run_grid(base: ExperimentConfig, defenses=None, attacks=None,
             emit({"defense": defense, "attack": attack, "skipped": str(e)})
             continue
         t0 = time.time()
-        logger = RunLogger(cfg, cfg.output, cfg.log_dir,
-                           jsonl_name=f"grid_{defense}_{attack}")
         try:
-            out = exp.run(logger)
+            # Context-managed: a cell that dies still closes its JSONL
+            # and flushes its accuracy CSV (utils/metrics.py:RunLogger).
+            with RunLogger(cfg, cfg.output, cfg.log_dir,
+                           jsonl_name=f"grid_{defense}_{attack}") as logger:
+                out = exp.run(logger)
         except FloatingPointError as e:  # backdoor nan guard — record cell
             emit({"defense": defense, "attack": attack, "failed": str(e),
                   "wall_s": round(time.time() - t0, 2)})

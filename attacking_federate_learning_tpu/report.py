@@ -1,0 +1,204 @@
+"""Run-report tool: read one or more run JSONLs, print forensics.
+
+The reference's only artifacts are a print tee and an accuracy CSV
+(SURVEY.md §5); every analysis in GRID_RESULTS.md (selection
+concentration, timing attribution, ASR trajectories) was hand-rolled per
+study.  This module automates them over the structured event schema
+(utils/metrics.py):
+
+- **selection concentration** — distinct winners, top-1 share, malicious
+  share, per-client histogram, from 'defense' events' selection masks
+  (Krum one-hot, Bulyan multi-hot) or the end-of-run 'selection_hist';
+- **phase timing** — the PhaseTimer summary from 'profile' events;
+- **trajectories** — accuracy from 'eval' events, attack success from
+  'asr' events.
+
+Usage (cli.py dispatches the subcommand)::
+
+    python -m attacking_federate_learning_tpu.cli report logs/run.jsonl
+    python -m attacking_federate_learning_tpu.cli report --json a.jsonl b.jsonl
+
+Multiple files print side by side plus a concentration comparison table —
+the iid-vs-femnist_style trend (GRID_RESULTS round-5 row) is one report
+invocation over the two run logs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from collections import Counter
+
+from attacking_federate_learning_tpu.utils.metrics import iter_events
+
+
+def load_events(paths, validate: bool = True) -> list:
+    """All events from the given JSONLs, schema-validated by default."""
+    events = []
+    for p in paths:
+        events.extend(iter_events(p, validate=validate))
+    return events
+
+
+def selection_concentration(events):
+    """The GRID_RESULTS top-1-share analysis, automated.
+
+    Winners come from 'defense' events' ``selection_mask`` vectors.  A
+    run of one-hot masks (Krum) yields a winner histogram with integer
+    counts and a malicious-picks total; multi-hot masks (Bulyan) yield
+    selection-mass shares.  Returns None when no masks were recorded.
+    NaN masks (host engines that never ship the selection back) are
+    skipped."""
+    masks = []
+    for e in events:
+        if e.get("kind") == "defense" and "selection_mask" in e:
+            m = e["selection_mask"]
+            if all(x == x for x in m):      # NaN-free (x != x iff NaN)
+                masks.append((m, e.get("malicious_count", 0)))
+    if not masks:
+        return None
+    one_hot = all(abs(sum(m) - 1.0) < 1e-6 for m, _ in masks)
+    counts: Counter = Counter()
+    mal_mass = total = 0.0
+    for m, f in masks:
+        for i, x in enumerate(m):
+            if x > 0:
+                counts[i] += x
+                total += x
+                if i < f:
+                    mal_mass += x
+    top1_client, top1 = counts.most_common(1)[0]
+    out = {
+        "rounds": len(masks),
+        "distinct_winners": len(counts),
+        "top1_share": round(top1 / total, 4),
+        "top1_client": top1_client,
+        "malicious_share": round(mal_mass / total, 4),
+        "histogram": {str(k): (int(v) if one_hot else round(v, 2))
+                      for k, v in sorted(counts.items())},
+    }
+    if one_hot:
+        out["malicious_picks"] = int(round(mal_mass))
+    return out
+
+
+def summarize_run(events):
+    """One run's report payload from its event list."""
+    kinds = Counter(e["kind"] for e in events)
+    out = {"events": len(events), "kinds": dict(kinds)}
+    for e in events:
+        if e["kind"] == "defense":
+            out["defense"] = e["defense"]
+            break
+    for e in events:
+        if e["kind"] == "attack":
+            out["attack"] = e["attack"]
+            break
+    evals = [(e["round"], e["accuracy"]) for e in events
+             if e["kind"] == "eval"]
+    if evals:
+        out["accuracy"] = {
+            "trajectory": [[r, round(a, 2)] for r, a in evals],
+            "final": round(evals[-1][1], 2),
+            "max": round(max(a for _, a in evals), 2)}
+    asrs = [(e["round"], e["attack_success_rate"]) for e in events
+            if e["kind"] == "asr"]
+    if asrs:
+        out["attack_success"] = {
+            "trajectory": [[r, round(a, 2)] for r, a in asrs],
+            "final": round(asrs[-1][1], 2)}
+    sel = selection_concentration(events)
+    if sel:
+        out["selection"] = sel
+    hists = [e for e in events if e["kind"] == "selection_hist"]
+    if hists:
+        out["selection_hist"] = {
+            k: hists[-1][k] for k in ("counts", "rounds", "distinct_winners",
+                                      "top1_share", "top1_client",
+                                      "malicious_picks")
+            if k in hists[-1]}
+    profiles = [e for e in events if e["kind"] == "profile"]
+    if profiles:
+        out["phases"] = profiles[-1]["phases"]
+    streams = [e for e in events if e["kind"] == "stream"]
+    if streams:
+        out["stream"] = {k: v for k, v in streams[-1].items()
+                         if k.startswith("stream_")}
+    return out
+
+
+def _print_run(path, s, out):
+    out(f"== {path} ==")
+    head = [f"{s['events']} events"]
+    if "defense" in s:
+        head.append(f"defense={s['defense']}")
+    if "attack" in s:
+        head.append(f"attack={s['attack']}")
+    out("  " + "  ".join(head))
+    if "accuracy" in s:
+        traj = " -> ".join(f"[{r}] {a:.2f}%"
+                           for r, a in s["accuracy"]["trajectory"])
+        out(f"  accuracy: {traj}  (max {s['accuracy']['max']:.2f}%)")
+    if "attack_success" in s:
+        traj = " -> ".join(f"[{r}] {a:.2f}%"
+                           for r, a in s["attack_success"]["trajectory"])
+        out(f"  attack success: {traj}")
+    sel = s.get("selection")
+    if sel:
+        out(f"  selection concentration over {sel['rounds']} rounds:")
+        out(f"    distinct winners {sel['distinct_winners']}, "
+            f"top-1 share {sel['top1_share']:.3f} "
+            f"(client {sel['top1_client']}), "
+            f"malicious share {sel['malicious_share']:.3f}"
+            + (f", malicious picks {sel['malicious_picks']}"
+               if "malicious_picks" in sel else ""))
+        hist = "  ".join(f"{k}:{v}" for k, v in sel["histogram"].items())
+        out(f"    histogram  {hist}")
+    if "phases" in s:
+        out("  phase timing:")
+        for name, row in s["phases"].items():
+            out(f"    {name:10s} total {row['total_s']:9.3f} s   "
+                f"count {row['count']:5d}   mean {row['mean_ms']:8.3f} ms")
+    if "stream" in s:
+        out("  stream: " + "  ".join(f"{k}={v}"
+                                     for k, v in s["stream"].items()))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="attacking_federate_learning_tpu report",
+        description="Summarize structured run JSONLs: selection "
+                    "concentration, phase timing, accuracy/ASR "
+                    "trajectories (utils/metrics.py event schema).")
+    p.add_argument("paths", nargs="+", metavar="RUN_JSONL")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (one object keyed by "
+                        "path)")
+    p.add_argument("--no-validate", action="store_true",
+                   help="skip schema validation (reading logs from a "
+                        "newer/older writer)")
+    args = p.parse_args(argv)
+
+    runs = {}
+    for path in args.paths:
+        runs[path] = summarize_run(
+            load_events([path], validate=not args.no_validate))
+
+    if args.json:
+        print(json.dumps(runs))
+        return 0
+    for path, s in runs.items():
+        _print_run(path, s, print)
+    with_sel = {p: s["selection"] for p, s in runs.items()
+                if "selection" in s}
+    if len(with_sel) > 1:
+        print("== selection concentration across runs ==")
+        for path, sel in with_sel.items():
+            print(f"  top-1 share {sel['top1_share']:.3f}  "
+                  f"distinct {sel['distinct_winners']:3d}  "
+                  f"malicious {sel['malicious_share']:.3f}  {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

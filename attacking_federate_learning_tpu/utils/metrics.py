@@ -1,13 +1,22 @@
-"""Structured run metrics and logging.
+"""Structured run metrics, the event schema, and logging.
 
 The reference logs via a print/file tee closure (reference main.py:13-18), a
 ``locals()`` config dump (main.py:19), accuracy lines every TEST_STEP rounds
 (main.py:77-80) and a CSV of the accuracy trajectory whose filename encodes
 every hyperparameter (main.py:100).  This module keeps all of those outputs
 (tee, config dump, CSV with the same filename schema) and adds what the
-reference lacks (SURVEY.md §5): structured per-round JSONL records with
-round, lr, clean accuracy, loss, attack-success rate and wall-clock phase
-timings.
+reference lacks (SURVEY.md §5): a versioned schema of structured JSONL
+events — per-round diagnostics, eval/ASR trajectories, phase timings,
+stream stall stats, and the telemetry pipeline's per-round defense/attack
+forensics (core/engine.py) — validated at the emitter so malformed events
+fail the producing run, not a downstream reader.
+
+Event contract (schema v1): every event is one JSON object per line with a
+``kind`` from :data:`EVENT_KINDS`, that kind's required fields, a schema
+version ``v`` and a relative timestamp ``t``.  Extra fields are always
+allowed (they're how diagnostics grow without a version bump); missing
+required fields or unknown kinds are errors.  ``tools/check_events.py`` is
+the standalone validator; ``report.py`` is the reader.
 """
 
 from __future__ import annotations
@@ -21,7 +30,91 @@ from typing import Optional
 import numpy as np
 
 
+SCHEMA_VERSION = 1
+
+# kind -> required fields.  Producers: core/engine.py (round, eval, asr,
+# profile, stream, defense, attack, selection_hist via RunLogger).
+EVENT_KINDS = {
+    # per-round scalar diagnostics (--round-stats)
+    "round": {"round"},
+    # eval-cadence accuracy line (reference main.py:77-80, structured)
+    "eval": {"round", "test_loss", "accuracy", "correct", "test_size"},
+    # backdoor attack-success rate at eval cadence
+    "asr": {"round", "attack_success_rate"},
+    # PhaseTimer summary written once at run end (--profile)
+    "profile": {"phases"},
+    # host-stream stall accounting (data/stream.py stall_stats)
+    "stream": {"stream_stall_s", "stream_gets"},
+    # per-round defense forensics (--telemetry): selection masks/scores,
+    # trim/clip/trust diagnostics, per-client norms + cosine-to-mean
+    "defense": {"round", "defense"},
+    # per-round attack envelope stats (--telemetry): ALIE z/sigma/drift
+    # norms, backdoor shadow loss
+    "attack": {"round", "attack"},
+    # end-of-run selection histogram (the GRID_RESULTS top-1 analysis)
+    "selection_hist": {"defense", "counts"},
+}
+
+
+def validate_event(rec) -> dict:
+    """Validate one event against the schema; returns it or raises
+    ValueError.  Unknown kinds and missing required fields are errors;
+    extra fields are not (diagnostics grow without a version bump)."""
+    if not isinstance(rec, dict):
+        raise ValueError(
+            f"event must be a JSON object, got {type(rec).__name__}")
+    kind = rec.get("kind")
+    if kind not in EVENT_KINDS:
+        raise ValueError(
+            f"unknown event kind {kind!r} (schema v{SCHEMA_VERSION}; "
+            f"known: {sorted(EVENT_KINDS)})")
+    missing = EVENT_KINDS[kind] - rec.keys()
+    if missing:
+        raise ValueError(
+            f"{kind!r} event missing required fields {sorted(missing)}")
+    v = rec.get("v", SCHEMA_VERSION)
+    if v != SCHEMA_VERSION:
+        raise ValueError(f"unsupported event schema version {v!r} "
+                         f"(this reader speaks v{SCHEMA_VERSION})")
+    if "round" in EVENT_KINDS[kind] and not isinstance(
+            rec["round"], (int, float)):
+        raise ValueError(
+            f"{kind!r} event field 'round' must be numeric, "
+            f"got {rec['round']!r}")
+    return rec
+
+
+def iter_events(path, validate: bool = True):
+    """Yield events from a run JSONL, optionally schema-validated.
+    Raises ValueError (with the line number) on a malformed line so a
+    reader never silently consumes drifted events."""
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{lineno}: not JSON: {e}") from e
+            if validate:
+                try:
+                    validate_event(rec)
+                except ValueError as e:
+                    raise ValueError(f"{path}:{lineno}: {e}") from e
+            yield rec
+
+
 class RunLogger:
+    """Tee + CSV + structured JSONL sink; a context manager.
+
+    ``with RunLogger(cfg) as logger:`` guarantees the JSONL handle is
+    closed and the accuracy CSV is written even when the run raises
+    (crash-safe ``close``).  ``finish()`` (CSV + JSONL close) is
+    idempotent and leaves the tee handle open so callers can still
+    ``print`` a trailing summary line; ``close()`` / ``__exit__`` shut
+    everything."""
+
     def __init__(self, config, output: Optional[str] = None,
                  log_dir: str = "logs", jsonl_name: Optional[str] = None):
         self.config = config
@@ -32,15 +125,28 @@ class RunLogger:
         base = jsonl_name or config.csv_name().replace(".csv", "")
         self.jsonl_path = os.path.join(log_dir, base + ".jsonl")
         self._jsonl = open(self.jsonl_path, "a")
+        # Reference-style tee (main.py:13-18): append semantics, but the
+        # handle is opened ONCE and kept — the reference reopened the
+        # file on every print.
+        self._tee = open(self.output, "a") if self.output else None
+        self._finished = False
         self.accuracies: list = []
         self.accuracies_epochs: list = []
         self._t0 = time.time()
 
+    # --- context manager ------------------------------------------------
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
     # --- reference-style tee (main.py:13-18) ---------------------------
     def print(self, s, end="\n"):
-        if self.output:
-            with open(self.output, "a+") as f:
-                f.write(str(s) + end)
+        if self._tee is not None:
+            self._tee.write(str(s) + end)
+            self._tee.flush()  # per-call reopen flushed implicitly
         else:
             print(s, end=end, flush=True)
 
@@ -50,6 +156,11 @@ class RunLogger:
     # --- structured records --------------------------------------------
     def record(self, **fields):
         fields.setdefault("t", round(time.time() - self._t0, 3))
+        if "kind" in fields:
+            # Validate at the emitter: a malformed event fails the run
+            # that produced it, not a later reader.
+            fields.setdefault("v", SCHEMA_VERSION)
+            validate_event(fields)
         self._jsonl.write(json.dumps(fields, default=float) + "\n")
         self._jsonl.flush()
 
@@ -72,9 +183,19 @@ class RunLogger:
         return accuracy
 
     def finish(self):
+        """Write the CSV and close the JSONL.  Idempotent; the tee stays
+        open (trailing summary prints still tee) until close()."""
+        if self._finished:
+            return
+        self._finished = True
         if self.accuracies:
             self.print("Max accuracy: {}".format(max(self.accuracies)))
             # CSV with the reference's filename schema (main.py:100).
             np.savetxt(os.path.join(self.log_dir, self.config.csv_name()),
                        np.asarray(self.accuracies), delimiter=",")
         self._jsonl.close()
+
+    def close(self):
+        self.finish()
+        if self._tee is not None and not self._tee.closed:
+            self._tee.close()

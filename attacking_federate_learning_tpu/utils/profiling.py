@@ -28,17 +28,21 @@ class PhaseTimer:
     def phase(self, name: str, sync_on=None):
         """``sync_on``: array (or zero-arg callable returning one, evaluated
         after the block so it can reference freshly produced state) to
-        block on before stopping the clock."""
+        block on before stopping the clock.  The phase is accounted even
+        when the block or the sync target raises — the wall-clock was
+        spent either way."""
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            if sync_on is not None:
-                jax.block_until_ready(sync_on() if callable(sync_on)
-                                      else sync_on)
-            dt = time.perf_counter() - t0
-            self.totals[name] += dt
-            self.counts[name] += 1
+            try:
+                if sync_on is not None:
+                    jax.block_until_ready(sync_on() if callable(sync_on)
+                                          else sync_on)
+            finally:
+                dt = time.perf_counter() - t0
+                self.totals[name] += dt
+                self.counts[name] += 1
 
     def summary(self) -> dict:
         return {name: {"total_s": round(self.totals[name], 4),

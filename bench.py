@@ -77,6 +77,11 @@ RESULT: dict = {}   # headline snapshot for the final-deadline escape hatch
 _EMITTED = False    # once-guard: main() + the deadline timer both emit
 _T0 = time.perf_counter()   # bench start; anchors the window_s metadata
 PHASES_DONE: list[str] = []  # names of phases that ran to completion
+PHASE_TIMER = None  # utils.profiling.PhaseTimer, set in main() (the module
+                    # imports jax, so construction waits for backend setup);
+                    # every phase() logs into it and RESULT['phase_timing']
+                    # carries the summary — BENCH_*.json gains phase-level
+                    # wall-clock attribution, partial captures included.
 
 
 def log(msg):
@@ -153,6 +158,13 @@ def phase(name, seconds):
     finally:
         signal.alarm(0)
         signal.signal(signal.SIGALRM, old)
+        if PHASE_TIMER is not None:
+            # Wall-clock attribution even for skipped phases (the time
+            # was spent either way); summary re-embedded each phase so
+            # the deadline escape hatch emits whatever accumulated.
+            PHASE_TIMER.totals[name] += time.perf_counter() - t0
+            PHASE_TIMER.counts[name] += 1
+            RESULT["phase_timing"] = PHASE_TIMER.summary()
 
 
 def relay_alive():
@@ -421,6 +433,11 @@ def main():
 
     import jax
     import jax.numpy as jnp
+
+    global PHASE_TIMER
+    from attacking_federate_learning_tpu.utils.profiling import PhaseTimer
+
+    PHASE_TIMER = PhaseTimer()
 
     from attacking_federate_learning_tpu.defenses.kernels import (
         bulyan, krum, trimmed_mean

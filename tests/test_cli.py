@@ -146,3 +146,40 @@ def test_model_override_flag(tmp_path):
     _, result = run_cli(tmp_path, ["-n", "6", "-m", "0.0",
                                    "--model", "mnist_cnn"], epochs=2)
     assert len(result["accuracies"]) >= 1
+
+
+def test_telemetry_flag_and_report_subcommand(tmp_path, capsys):
+    """--telemetry writes schema-valid defense/attack/selection_hist
+    events; the report subcommand reads them back."""
+    from attacking_federate_learning_tpu.utils.metrics import validate_event
+
+    run_cli(tmp_path, ["-n", "9", "-m", "0.22", "-d", "Krum",
+                       "--telemetry"], epochs=4)
+    logs = tmp_path / "logs"
+    jsonl = [f for f in os.listdir(logs) if f.endswith(".jsonl")][0]
+    path = str(logs / jsonl)
+    records = [json.loads(line)
+               for line in open(path).read().splitlines()]
+    for r in records:
+        validate_event(r)
+    defense = [r for r in records if r["kind"] == "defense"]
+    assert len(defense) == 4
+    assert all("selection_mask" in r and "client_norms" in r
+               for r in defense)
+    assert [r for r in records if r["kind"] == "selection_hist"]
+    capsys.readouterr()
+    from attacking_federate_learning_tpu import cli as cli_mod
+    assert cli_mod.main(["report", path]) == 0
+    out = capsys.readouterr().out
+    assert "selection concentration" in out
+
+
+def test_crash_still_writes_csv(tmp_path):
+    """RunLogger is context-managed in cli.main: a run that raises
+    after the logger opens still exits cleanly through __exit__ (here:
+    the Bulyan n >= 4f+3 guard), leaving the JSONL artifact behind."""
+    with pytest.raises(ValueError, match="Bulyan requires"):
+        run_cli(tmp_path, ["-n", "10", "-m", "0.24", "-d", "Bulyan"],
+                epochs=2)
+    logs = tmp_path / "logs"
+    assert [f for f in os.listdir(logs) if f.endswith(".jsonl")]

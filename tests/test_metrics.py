@@ -1,15 +1,21 @@
-"""RunLogger (tee, CSV schema, JSONL records) and PhaseTimer."""
+"""RunLogger (tee, CSV schema, JSONL records, context manager), the
+event schema, and the profiling hooks (PhaseTimer, xla_trace)."""
 
 import json
 import os
 import time
 
 import numpy as np
+import pytest
 
 from attacking_federate_learning_tpu import config as C
 from attacking_federate_learning_tpu.config import ExperimentConfig
-from attacking_federate_learning_tpu.utils.metrics import RunLogger
-from attacking_federate_learning_tpu.utils.profiling import PhaseTimer
+from attacking_federate_learning_tpu.utils.metrics import (
+    RunLogger, SCHEMA_VERSION, iter_events, validate_event
+)
+from attacking_federate_learning_tpu.utils.profiling import (
+    PhaseTimer, xla_trace
+)
 
 
 def make_cfg(tmp_path, **kw):
@@ -65,3 +71,114 @@ def test_phase_timer_accumulates_and_syncs():
     assert s["a"]["count"] == 2
     assert s["a"]["total_s"] >= 0.02
     assert s["b"]["count"] == 1
+
+
+def test_tee_handle_opened_once(tmp_path):
+    """The tee opens ONCE at construction (the reference — and the old
+    RunLogger.print — reopened the file per call); finish() leaves it
+    open for trailing summary lines, close() shuts it."""
+    out = tmp_path / "tee.log"
+    cfg = make_cfg(tmp_path, output=str(out))
+    logger = RunLogger(cfg, cfg.output, cfg.log_dir)
+    handle = logger._tee
+    assert handle is not None
+    logger.print("one")
+    logger.print("two")
+    assert logger._tee is handle          # never reopened
+    logger.finish()
+    assert not handle.closed              # tee survives finish()
+    logger.print("after finish")          # trailing summary still tees
+    logger.close()
+    assert handle.closed
+    assert out.read_text() == "one\ntwo\nafter finish\n"
+
+
+def test_runlogger_context_manager_crash_safe(tmp_path):
+    """Satellite: the JSONL handle is closed and the accuracy CSV is
+    written even when the run raises inside the with block."""
+    cfg = make_cfg(tmp_path, defense="Median")
+    with pytest.raises(RuntimeError, match="boom"):
+        with RunLogger(cfg, None, cfg.log_dir) as logger:
+            logger.record_eval(epoch=0, test_loss=0.5, correct=1000,
+                               test_size=2000)
+            raise RuntimeError("boom")
+    assert logger._jsonl.closed
+    csv = os.path.join(cfg.log_dir, cfg.csv_name())
+    assert os.path.exists(csv)
+    np.testing.assert_allclose(np.loadtxt(csv, delimiter=","), 50.0)
+    # finish/close are idempotent — a second exit must not explode.
+    logger.close()
+
+
+def test_event_schema_validation(tmp_path):
+    validate_event({"kind": "round", "round": 3})
+    validate_event({"kind": "eval", "round": 0, "test_loss": 0.1,
+                    "accuracy": 50.0, "correct": 1, "test_size": 2})
+    with pytest.raises(ValueError, match="unknown event kind"):
+        validate_event({"kind": "nope"})
+    with pytest.raises(ValueError, match="missing required"):
+        validate_event({"kind": "asr", "round": 1})
+    with pytest.raises(ValueError, match="schema version"):
+        validate_event({"kind": "round", "round": 1, "v": 99})
+    with pytest.raises(ValueError, match="must be numeric"):
+        validate_event({"kind": "round", "round": "three"})
+
+
+def test_record_stamps_version_and_iter_events_roundtrip(tmp_path):
+    cfg = make_cfg(tmp_path)
+    with RunLogger(cfg, None, cfg.log_dir, jsonl_name="rt") as logger:
+        logger.record(kind="round", round=0, extra_field=1.5)
+        logger.record(freeform="no kind, no validation")
+        path = logger.jsonl_path
+    recs = list(iter_events(path, validate=False))
+    assert recs[0]["v"] == SCHEMA_VERSION and recs[0]["extra_field"] == 1.5
+    assert "v" not in recs[1]
+    with pytest.raises(ValueError, match="unknown event kind"):
+        list(iter_events(path))           # validating reader flags line 2
+
+
+def test_phase_timer_sync_on_callable_is_deferred():
+    """Satellite: sync_on=callable is evaluated AFTER the block, so it
+    can reference state the block itself produces (engine.run's eval
+    phase reads `correct` assigned inside the block)."""
+    import jax.numpy as jnp
+
+    timer = PhaseTimer()
+    box = {}
+    with timer.phase("p", sync_on=lambda: box["x"]):
+        box["x"] = jnp.arange(4)   # KeyError if evaluated at entry
+    assert timer.counts["p"] == 1
+    # Non-callable arrays block directly.
+    with timer.phase("q", sync_on=jnp.ones(3)):
+        pass
+    assert timer.counts["q"] == 1
+
+
+def test_phase_timer_sync_failure_still_records():
+    """The timer accounts the phase even when the sync target raises
+    (the finally path)."""
+    timer = PhaseTimer()
+    with pytest.raises(KeyError):
+        with timer.phase("r", sync_on=lambda: {}["missing"]):
+            pass
+    assert timer.counts["r"] == 1
+
+
+def test_xla_trace_noop_and_active(tmp_path, monkeypatch):
+    """Satellite: no log_dir -> the profiler is never touched; with one,
+    start/stop bracket the block."""
+    import jax
+
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop",)))
+    with xla_trace(None):
+        pass
+    with xla_trace(""):
+        pass
+    assert calls == []                     # no-op branch
+    with xla_trace(str(tmp_path)):
+        pass
+    assert calls == [("start", str(tmp_path)), ("stop",)]

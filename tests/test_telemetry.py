@@ -1,0 +1,373 @@
+"""Telemetry pipeline: kernel diagnostics, engine threading, event
+schema round-trip, the report tool, and the check_events validator.
+
+Acceptance contract (ISSUE 1): with telemetry OFF every aggregation is
+bit-identical to the pre-telemetry kernels and the fused round loop still
+compiles as one jit (the on/off trajectory test); with it ON a 30-round
+SYNTH_MNIST_HARD Krum-vs-ALIE run emits per-round selection masks whose
+top-1 concentration, computed by the report tool, reproduces the pinned
+GRID_RESULTS femnist_style trend (IID diffuse -> styled concentrated).
+"""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attacking_federate_learning_tpu import config as C
+from attacking_federate_learning_tpu import report
+from attacking_federate_learning_tpu.attacks import DriftAttack, make_attacker
+from attacking_federate_learning_tpu.config import ExperimentConfig
+from attacking_federate_learning_tpu.core.engine import FederatedExperiment
+from attacking_federate_learning_tpu.data.datasets import load_dataset
+from attacking_federate_learning_tpu.defenses import DEFENSES
+from attacking_federate_learning_tpu.defenses.kernels import (
+    bulyan, krum, krum_select, population_telemetry, trimmed_mean
+)
+from attacking_federate_learning_tpu.utils.metrics import (
+    EVENT_KINDS, RunLogger, validate_event
+)
+
+
+# ---------------------------------------------------------------------------
+# kernel diagnostics (defenses/kernels.py and friends)
+
+def _grads(n=15, d=40, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+
+
+@pytest.mark.parametrize("name,extra", [
+    ("NoDefense", {}), ("Krum", {}), ("TrimmedMean", {}), ("Bulyan", {}),
+    ("Median", {}), ("GeoMedian", {}), ("CenteredClip", {}),
+    ("NormBound", {}), ("DnC", {"seed": 3, "round": 1}),
+])
+def test_kernel_telemetry_bit_identical_and_fixed_shape(name, extra):
+    """telemetry=True must not perturb the aggregate (bit-for-bit) and
+    must return fixed-shape diagnostics."""
+    G, n, f = _grads(), 15, 3
+    fn = DEFENSES[name]
+    plain = np.asarray(fn(G, n, f, **extra))
+    agg, diag = fn(G, n, f, telemetry=True, **extra)
+    np.testing.assert_array_equal(plain, np.asarray(agg))
+    for k, v in diag.items():
+        assert np.asarray(v).shape in ((), (n,)), (name, k)
+
+
+def test_fltrust_telemetry_trust_scores():
+    G, n, f = _grads(), 15, 3
+    g0 = jnp.asarray(np.random.default_rng(1)
+                     .standard_normal(40).astype(np.float32))
+    fn = DEFENSES["FLTrust"]
+    plain = np.asarray(fn(G, n, f, server_grad=g0))
+    agg, diag = fn(G, n, f, server_grad=g0, telemetry=True)
+    np.testing.assert_array_equal(plain, np.asarray(agg))
+    ts = np.asarray(diag["trust_scores"])
+    cos = np.asarray(diag["cosine"])
+    assert ts.shape == (n,) and (ts >= 0).all()
+    np.testing.assert_allclose(ts, np.maximum(cos, 0.0), atol=1e-7)
+
+
+def test_krum_telemetry_mask_marks_aggregated_row():
+    """The one-hot mask and the score argmin must both point at the row
+    krum_select reports — same single distance computation."""
+    G, n, f = _grads(seed=7), 15, 3
+    want = int(krum_select(G, n, f))
+    agg, diag = krum(G, n, f, telemetry=True)
+    mask = np.asarray(diag["selection_mask"])
+    assert mask.sum() == 1.0 and int(np.argmax(mask)) == want
+    assert int(np.argmin(np.asarray(diag["scores"]))) == want
+    np.testing.assert_array_equal(np.asarray(agg), np.asarray(G)[want])
+
+
+def test_krum_telemetry_under_jit_matches_eager():
+    G, n, f = _grads(seed=9), 15, 3
+    fn = jax.jit(lambda g: krum(g, n, f, telemetry=True))
+    agg_j, diag_j = fn(G)
+    agg_e, diag_e = krum(G, n, f, telemetry=True)
+    np.testing.assert_array_equal(np.asarray(agg_j), np.asarray(agg_e))
+    np.testing.assert_array_equal(np.asarray(diag_j["selection_mask"]),
+                                  np.asarray(diag_e["selection_mask"]))
+
+
+def test_trimmed_mean_kept_fraction_accounting():
+    """Each coordinate keeps exactly n-f-1 clients, so the per-client
+    kept fractions must sum to n-f-1."""
+    G, n, f = _grads(seed=3), 15, 3
+    _, diag = trimmed_mean(G, n, f, telemetry=True)
+    kept = np.asarray(diag["kept_fraction"])
+    assert kept.shape == (n,)
+    np.testing.assert_allclose(kept.sum(), n - f - 1, rtol=1e-5)
+    np.testing.assert_allclose(float(diag["trim_fraction"]),
+                               1.0 - (n - f - 1) / n, rtol=1e-6)
+
+
+def test_bulyan_telemetry_mask_is_selection_set():
+    G, n, f = _grads(seed=5), 15, 3
+    _, diag = bulyan(G, n, f, telemetry=True)
+    mask = np.asarray(diag["selection_mask"])
+    assert mask.sum() == n - 2 * f
+    # Hybrid exact selection must mark the same set on plain inputs
+    # (tests/test_defenses.py pins hybrid==xla aggregation already).
+    _, diag_h = bulyan(G, n, f, selection_impl="host", telemetry=True)
+    np.testing.assert_array_equal(mask, np.asarray(diag_h["selection_mask"]))
+
+
+def test_population_telemetry_shapes_and_values():
+    G = _grads(seed=11)
+    pt = population_telemetry(G)
+    norms = np.asarray(pt["client_norms"])
+    cos = np.asarray(pt["cosine_to_mean"])
+    np.testing.assert_allclose(norms, np.linalg.norm(np.asarray(G), axis=1),
+                               rtol=1e-6)
+    assert (np.abs(cos) <= 1.0 + 1e-5).all()
+
+
+def test_attack_envelope_stats():
+    """ALIE envelope stats mirror the craft arithmetic on the malicious
+    cohort; NoAttack/z=0 report nothing."""
+    from attacking_federate_learning_tpu.attacks import NoAttack
+
+    G, f = _grads(seed=13), 4
+    atk = DriftAttack(num_std=1.5)
+    stats = atk.envelope_stats(G, f)
+    mal = np.asarray(G)[:f]
+    np.testing.assert_allclose(float(stats["sigma_norm"]),
+                               np.linalg.norm(mal.std(0)), rtol=1e-5)
+    np.testing.assert_allclose(float(stats["drift_norm"]),
+                               1.5 * np.linalg.norm(mal.std(0)), rtol=1e-5)
+    assert float(stats["z"]) == 1.5
+    assert DriftAttack(num_std=0.0).envelope_stats(G, f) == {}
+    assert NoAttack().envelope_stats(G, f) == {}
+
+
+# ---------------------------------------------------------------------------
+# engine threading
+
+def _tele_cfg(tmp_path, **kw):
+    kw.setdefault("dataset", C.SYNTH_MNIST)
+    kw.setdefault("users_count", 9)
+    kw.setdefault("mal_prop", 0.22)
+    kw.setdefault("batch_size", 16)
+    kw.setdefault("epochs", 5)
+    kw.setdefault("test_step", 5)
+    kw.setdefault("synth_train", 256)
+    kw.setdefault("synth_test", 64)
+    kw.setdefault("log_dir", str(tmp_path))
+    return ExperimentConfig(**kw)
+
+
+def _run(cfg, tmp_path, name, timer=None, attacker=None):
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=cfg.synth_train,
+                      synth_test=cfg.synth_test)
+    if attacker is None:
+        attacker = (make_attacker(cfg, dataset=ds) if cfg.backdoor
+                    else DriftAttack(1.0))
+    exp = FederatedExperiment(cfg, attacker=attacker, dataset=ds)
+    with RunLogger(cfg, None, str(tmp_path), jsonl_name=name) as logger:
+        result = exp.run(logger, timer=timer)
+    with open(logger.jsonl_path) as f:
+        events = [json.loads(line) for line in f]
+    return result, events
+
+
+def test_telemetry_off_trajectory_bit_identical(tmp_path):
+    """Acceptance: telemetry must be a pure observer — the on/off
+    trajectories agree bit for bit (spans fused either way)."""
+    r_off, _ = _run(_tele_cfg(tmp_path, defense="Krum", telemetry=False),
+                    tmp_path, "off")
+    r_on, events = _run(_tele_cfg(tmp_path, defense="Krum", telemetry=True),
+                        tmp_path, "on")
+    np.testing.assert_array_equal(np.asarray(r_off["final_weights"]),
+                                  np.asarray(r_on["final_weights"]))
+    kinds = {e["kind"] for e in events}
+    assert {"defense", "attack", "eval", "selection_hist"} <= kinds
+
+
+def test_tele_span_matches_per_round_dispatch(tmp_path):
+    """The scanned telemetry span (one device program per eval interval,
+    stacked aux outputs) must emit the same per-round events as the
+    per-round dispatch path (here forced by a PhaseTimer)."""
+    from attacking_federate_learning_tpu.utils.profiling import PhaseTimer
+
+    cfg = _tele_cfg(tmp_path, defense="Krum", telemetry=True)
+    _, ev_span = _run(cfg, tmp_path, "span")
+    _, ev_round = _run(cfg, tmp_path, "per_round", timer=PhaseTimer())
+    d_span = [e for e in ev_span if e["kind"] == "defense"]
+    d_round = [e for e in ev_round if e["kind"] == "defense"]
+    assert [e["round"] for e in d_span] == [e["round"] for e in d_round]
+    for a, b in zip(d_span, d_round):
+        np.testing.assert_array_equal(a["selection_mask"],
+                                      b["selection_mask"])
+        np.testing.assert_allclose(a["scores"], b["scores"], rtol=1e-5)
+        np.testing.assert_allclose(a["client_norms"], b["client_norms"],
+                                   rtol=1e-5)
+
+
+def test_telemetry_under_device_mesh(tmp_path):
+    """Stacked telemetry aux outputs must survive the (clients, model)
+    mesh: same events, valid masks, no resharding surprises."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-virtual-device harness")
+    cfg = _tele_cfg(tmp_path, users_count=16, mal_prop=0.2, epochs=3,
+                    test_step=3, defense="Krum", telemetry=True,
+                    mesh_shape=(8, 1))
+    _, events = _run(cfg, tmp_path, "mesh")
+    dfs = [e for e in events if e["kind"] == "defense"]
+    assert len(dfs) == 3
+    for e in dfs:
+        assert sum(e["selection_mask"]) == 1.0
+        assert len(e["client_norms"]) == 16
+
+
+def test_staged_backdoor_telemetry_has_shadow_loss(tmp_path):
+    """The staged dispatch path (reference per-round nan-guard seam)
+    threads the same telemetry, including the backdoor's envelope stats
+    via AttackContext."""
+    cfg = _tele_cfg(tmp_path, users_count=8, mal_prop=0.25, epochs=2,
+                    test_step=2, defense="TrimmedMean", backdoor="pattern",
+                    backdoor_fused=False, telemetry=True,
+                    synth_train=512)
+    _, events = _run(cfg, tmp_path, "staged_bd")
+    atk = [e for e in events if e["kind"] == "attack"]
+    assert len(atk) == 2
+    for e in atk:
+        assert e["attack"] == "backdoor"
+        assert "shadow_loss" in e and "clip_halfwidth_norm" in e
+    dfs = [e for e in events if e["kind"] == "defense"]
+    assert len(dfs) == 2 and "kept_fraction" in dfs[0]
+
+
+# ---------------------------------------------------------------------------
+# schema round-trip (satellite): every kind the engine can emit, parsed
+# and validated from real CPU runs' JSONL
+
+def test_schema_roundtrip_every_engine_kind(tmp_path):
+    """5-round runs covering the full event surface: every record
+    validates, and the union of kinds is exactly the schema's."""
+    seen = set()
+    # Run 1: Krum + ALIE + telemetry + round stats + profile.
+    from attacking_federate_learning_tpu.utils.profiling import PhaseTimer
+
+    cfg1 = _tele_cfg(tmp_path, defense="Krum", telemetry=True,
+                     log_round_stats=True, epochs=5, test_step=2)
+    _, ev1 = _run(cfg1, tmp_path, "roundtrip1", timer=PhaseTimer())
+    # Run 2: backdoor (asr) + host-streamed data (stream) + telemetry.
+    cfg2 = _tele_cfg(tmp_path, users_count=8, mal_prop=0.25, epochs=5,
+                     test_step=2, defense="NoDefense", backdoor="pattern",
+                     data_placement="host_stream", telemetry=True,
+                     synth_train=512)
+    _, ev2 = _run(cfg2, tmp_path, "roundtrip2")
+    for rec in ev1 + ev2:
+        validate_event(rec)
+        assert rec["v"] == 1
+        seen.add(rec["kind"])
+    assert seen == set(EVENT_KINDS)
+
+
+def test_record_rejects_schema_drift(tmp_path):
+    """Emitter-side validation (utils/metrics.py): unknown kinds and
+    missing required fields fail the producing run."""
+    cfg = _tele_cfg(tmp_path)
+    with RunLogger(cfg, None, str(tmp_path), jsonl_name="drift") as logger:
+        with pytest.raises(ValueError, match="unknown event kind"):
+            logger.record(kind="not_a_kind", round=0)
+        with pytest.raises(ValueError, match="missing required"):
+            logger.record(kind="eval", round=0)
+        logger.record(kind="round", round=0)  # minimal valid event
+
+
+# ---------------------------------------------------------------------------
+# tools/check_events.py (satellite: wired into CI)
+
+def _load_check_events():
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "check_events.py")
+    spec = importlib.util.spec_from_file_location("check_events", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_check_events_validator(tmp_path):
+    ce = _load_check_events()
+    cfg = _tele_cfg(tmp_path, defense="TrimmedMean", telemetry=True,
+                    epochs=3, test_step=3)
+    _, _ = _run(cfg, tmp_path, "ce_ok")
+    good = os.path.join(str(tmp_path), "ce_ok.jsonl")
+    counts, legacy, errors = ce.check_file(good)
+    assert not errors and counts["defense"] == 3
+    assert ce.main([good]) == 0
+    # Malformed emitters are caught: bad kind, missing field, bad JSON.
+    bad = os.path.join(str(tmp_path), "ce_bad.jsonl")
+    with open(bad, "w") as f:
+        f.write(json.dumps({"kind": "defense", "round": 0,
+                            "defense": "Krum"}) + "\n")
+        f.write(json.dumps({"kind": "mystery"}) + "\n")
+        f.write(json.dumps({"kind": "eval", "round": 1}) + "\n")
+        f.write("{not json\n")
+        f.write(json.dumps({"free": "form"}) + "\n")
+    counts, legacy, errors = ce.check_file(bad)
+    assert len(errors) == 3 and legacy == 1 and counts == {"defense": 1}
+    assert ce.main([bad]) == 1
+    # --strict flags the free-form row too.
+    assert len(ce.check_file(bad, strict=True)[2]) == 4
+
+
+# ---------------------------------------------------------------------------
+# report tool + the pinned femnist_style selection-concentration trend
+
+def test_report_summarize_and_json(tmp_path, capsys):
+    from attacking_federate_learning_tpu import cli
+
+    cfg = _tele_cfg(tmp_path, defense="Krum", telemetry=True)
+    _, _ = _run(cfg, tmp_path, "rep")
+    path = os.path.join(str(tmp_path), "rep.jsonl")
+    capsys.readouterr()                   # drain the run's tee lines
+    assert cli.main(["report", "--json", path]) == 0
+    out = json.loads(capsys.readouterr().out)
+    s = out[path]
+    assert s["defense"] == "Krum" and s["attack"] == "alie"
+    sel = s["selection"]
+    assert sel["rounds"] == 5 and 0 < sel["top1_share"] <= 1
+    assert sel["top1_share"] == s["selection_hist"]["top1_share"]
+    # Human-readable mode renders the same numbers.
+    assert cli.main(["report", path]) == 0
+    text = capsys.readouterr().out
+    assert "selection concentration" in text and "top-1 share" in text
+
+
+def test_report_reproduces_femnist_style_concentration_trend(tmp_path):
+    """Acceptance: 30-round SYNTH_MNIST_HARD Krum-vs-ALIE, iid vs
+    femnist_style — the telemetry selection masks, aggregated by the
+    report tool, must reproduce the pinned GRID_RESULTS trend: styled
+    honest structure CONCENTRATES Krum's selection (top-1 share up,
+    distinct winners down vs iid)."""
+    shares = {}
+    winners = {}
+    for part in ("iid", "femnist_style"):
+        cfg = ExperimentConfig(
+            dataset=C.SYNTH_MNIST_HARD, users_count=19, mal_prop=0.2,
+            batch_size=64, epochs=30, test_step=30, defense="Krum",
+            partition=part, style_strength=0.5, telemetry=True,
+            log_dir=str(tmp_path))
+        ds = load_dataset(cfg.dataset, seed=0, synth_train=8000,
+                          synth_test=2000)
+        exp = FederatedExperiment(cfg, attacker=make_attacker(cfg,
+                                                              dataset=ds),
+                                  dataset=ds)
+        name = f"femnist_{part}"
+        with RunLogger(cfg, None, str(tmp_path), jsonl_name=name) as logger:
+            exp.run(logger)
+        sel = report.selection_concentration(
+            report.load_events([logger.jsonl_path]))
+        assert sel["rounds"] == 30          # a mask every round
+        shares[part] = sel["top1_share"]
+        winners[part] = sel["distinct_winners"]
+    # GRID_RESULTS round-5 row: top-1 share 0.17 -> 0.40 at strength 0.5.
+    assert shares["femnist_style"] > shares["iid"], (shares, winners)
+    assert winners["femnist_style"] < winners["iid"], (shares, winners)

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Standalone validator for structured run JSONLs.
+
+Checks every line of the given files against the event schema
+(attacking_federate_learning_tpu/utils/metrics.py: EVENT_KINDS /
+validate_event) so a malformed emitter is caught by CI, not by a reader
+weeks later.  No device work (validation is pure Python over parsed
+JSON), so it runs in tier-1 time budget on any backend state.
+
+Usage:
+    python tools/check_events.py logs/*.jsonl
+    python tools/check_events.py --strict run.jsonl   # free-form lines
+                                                      # are errors too
+
+Lines that are valid JSON objects WITHOUT a 'kind' field are counted as
+legacy/free-form rows and skipped by default (pre-schema logs — e.g. the
+grid drivers' summary rows); --strict flags them.  Exit status: 0 when
+every file is clean, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from attacking_federate_learning_tpu.utils.metrics import (  # noqa: E402
+    SCHEMA_VERSION, validate_event
+)
+
+
+def check_file(path, strict=False):
+    """Returns (per-kind counts, legacy-row count, [(lineno, error)])."""
+    counts: dict = {}
+    legacy = 0
+    errors = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append((lineno, f"not JSON: {e}"))
+                continue
+            if not isinstance(rec, dict) or "kind" not in rec:
+                legacy += 1
+                if strict:
+                    errors.append((lineno, "no 'kind' field (free-form "
+                                           "row; --strict forbids)"))
+                continue
+            try:
+                validate_event(rec)
+            except ValueError as e:
+                errors.append((lineno, str(e)))
+                continue
+            counts[rec["kind"]] = counts.get(rec["kind"], 0) + 1
+    return counts, legacy, errors
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=f"Validate run JSONLs against the event schema "
+                    f"(v{SCHEMA_VERSION}).")
+    p.add_argument("paths", nargs="+", metavar="JSONL")
+    p.add_argument("--strict", action="store_true",
+                   help="rows without a 'kind' field are errors, not "
+                        "legacy free-form lines")
+    args = p.parse_args(argv)
+
+    failed = False
+    for path in args.paths:
+        counts, legacy, errors = check_file(path, strict=args.strict)
+        kinds = "  ".join(f"{k}:{v}" for k, v in sorted(counts.items()))
+        tail = f"  (+{legacy} free-form)" if legacy else ""
+        if errors:
+            failed = True
+            print(f"FAIL {path}: {len(errors)} bad line(s)  "
+                  f"[{kinds}]{tail}")
+            for lineno, msg in errors[:20]:
+                print(f"  line {lineno}: {msg}")
+            if len(errors) > 20:
+                print(f"  ... and {len(errors) - 20} more")
+        else:
+            print(f"ok   {path}: {sum(counts.values())} events  "
+                  f"[{kinds}]{tail}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -7,9 +7,18 @@ their pairwise distances are no longer exchangeable noise — which is
 the condition distance-based defenses are sensitive to.  Label-skew
 (Dirichlet) alone never produces this on class-balanced synth data.
 
-Measured: Krum's 30-round selection histogram (distinct honest winners,
-top-1 share, malicious picks) and final accuracy, iid vs femnist_style,
-for Krum and Bulyan.  Results land in GRID_RESULTS.md.
+Measured: the 30-round selection histogram (distinct winners, top-1
+share, malicious picks) and final accuracy, iid vs femnist_style, for
+Krum and Bulyan.  Results land in GRID_RESULTS.md.
+
+Instrumentation: this study used to hand-roll its selection histogram
+from per-round ``last_round_stats``; it now IS one telemetry run
+(cfg.telemetry) — the engine writes per-round 'defense' events + the
+end-of-run 'selection_hist' to the run JSONL, and the concentration
+numbers come from report.selection_concentration, the same code path as
+``python -m attacking_federate_learning_tpu.cli report``.  Bulyan rows
+gain a selection-mass concentration (multi-hot masks) the old
+Krum-winner instrumentation could not see.
 
 Run (CPU):  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
             python tools/femnist_style_study.py
@@ -17,7 +26,6 @@ Run (CPU):  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 
 from __future__ import annotations
 
-import collections
 import json
 import os
 import sys
@@ -26,42 +34,46 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def run_cell(defense, part, strength=0.5, rounds=30):
+def run_cell(defense, part, strength=0.5, rounds=30, log_dir="logs"):
     from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu import report
     from attacking_federate_learning_tpu.attacks import make_attacker
     from attacking_federate_learning_tpu.config import ExperimentConfig
     from attacking_federate_learning_tpu.core.engine import (
         FederatedExperiment
     )
     from attacking_federate_learning_tpu.data.datasets import load_dataset
+    from attacking_federate_learning_tpu.utils.metrics import RunLogger
 
     cfg = ExperimentConfig(
         dataset=C.SYNTH_MNIST_HARD, users_count=19, mal_prop=0.2,
-        batch_size=64, epochs=rounds, defense=defense, partition=part,
-        style_strength=strength, log_round_stats=True)
+        batch_size=64, epochs=rounds, test_step=rounds, defense=defense,
+        partition=part, style_strength=strength, telemetry=True,
+        log_dir=log_dir)
     ds = load_dataset(cfg.dataset, seed=0, synth_train=8000,
                       synth_test=2000)
     exp = FederatedExperiment(cfg, attacker=make_attacker(cfg, dataset=ds),
                               dataset=ds)
-    sels: list[int] = []
-    mal_picks = 0
-    for t in range(rounds):
-        exp.run_round(t)
-        st = exp.last_round_stats
-        if st and "krum_selected" in st:
-            sels.append(int(st["krum_selected"]))
-            mal_picks += int(st["malicious_selected"])
-    _, correct = exp.evaluate(exp.state.weights)
-    acc = 100.0 * float(correct) / len(ds.test_y)
-    out = {"defense": defense, "partition": part, "final_acc": round(acc, 2)}
-    if sels:
-        counts = collections.Counter(sels)
+    jsonl_name = f"femnist_study_{defense}_{part}"
+    jsonl_path = os.path.join(log_dir, jsonl_name + ".jsonl")
+    if os.path.exists(jsonl_path):
+        os.remove(jsonl_path)  # RunLogger appends; one study = one log
+    with RunLogger(cfg, None, log_dir, jsonl_name=jsonl_name) as logger:
+        result = exp.run(logger)
+
+    out = {"defense": defense, "partition": part,
+           "final_acc": round(result["accuracies"][-1], 2),
+           "jsonl": jsonl_path}
+    sel = report.selection_concentration(report.load_events([jsonl_path]))
+    if sel:
         out.update(
-            distinct_winners=len(counts),
-            top1_share=round(counts.most_common(1)[0][1] / len(sels), 3),
-            top1_client=counts.most_common(1)[0][0],
-            malicious_picks=mal_picks,
-            histogram={str(k): v for k, v in sorted(counts.items())})
+            distinct_winners=sel["distinct_winners"],
+            top1_share=round(sel["top1_share"], 3),
+            top1_client=sel["top1_client"],
+            malicious_share=sel["malicious_share"],
+            histogram=sel["histogram"])
+        if "malicious_picks" in sel:
+            out["malicious_picks"] = sel["malicious_picks"]
     return out
 
 
