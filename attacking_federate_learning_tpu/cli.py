@@ -225,8 +225,42 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", nargs="?", const="auto", default=None,
                    metavar="CKPT",
                    help="resume from a checkpoint (.npz path, or no value "
-                        "to use runs/<dataset>/checkpoint.npz); continues "
-                        "from the saved round")
+                        "to use the newest checkpoint in runs/<dataset>/ — "
+                        "auto-checkpoints included); continues from the "
+                        "saved round, fault state included")
+    p.add_argument("--checkpoint-every", default=0, type=int,
+                   metavar="N",
+                   help="write a rotated, atomically-replaced auto-"
+                        "checkpoint every N rounds (0 = off) — the "
+                        "--resume target after a kill and the rollback "
+                        "target for the fault watchdog")
+    p.add_argument("--fault-dropout", default=0.0, type=float,
+                   metavar="P",
+                   help="per-client per-round dropout probability: the "
+                        "client returns no update; its row is "
+                        "quarantined out of the aggregation "
+                        "(core/faults.py)")
+    p.add_argument("--fault-straggler", default=0.0, type=float,
+                   metavar="P",
+                   help="per-client per-round straggler probability: the "
+                        "client submits its gradient from "
+                        "--fault-straggler-delay rounds ago (stale ring "
+                        "buffer inside the fused round)")
+    p.add_argument("--fault-straggler-delay", default=1, type=int,
+                   metavar="K", help="straggler staleness in rounds")
+    p.add_argument("--fault-corrupt", default=0.0, type=float,
+                   metavar="P",
+                   help="per-HONEST-client per-round corruption "
+                        "probability (distinct from the attack seam, "
+                        "which owns rows [0, f)); see "
+                        "--fault-corrupt-mode")
+    p.add_argument("--fault-corrupt-mode", default="nan",
+                   choices=["nan", "inf", "scale"],
+                   help="corruption flavor: non-finite rows ('nan'/'inf' "
+                        "— caught by the pre-aggregation quarantine) or "
+                        "finite bit-scaled rows ('scale' — what the "
+                        "robust defense / divergence watchdog must "
+                        "absorb)")
     p.add_argument("--profile", action="store_true",
                    help="accumulate per-phase (round/eval) wall-clock and "
                         "record it in the JSONL log")
@@ -249,7 +283,16 @@ def build_parser() -> argparse.ArgumentParser:
 def config_from_args(args) -> ExperimentConfig:
     mesh_shape = (tuple(int(x) for x in args.mesh_shape.split(","))
                   if args.mesh_shape else None)
+    faults = None
+    if args.fault_dropout or args.fault_straggler or args.fault_corrupt:
+        faults = C.FaultConfig(dropout=args.fault_dropout,
+                               straggler=args.fault_straggler,
+                               corrupt=args.fault_corrupt,
+                               straggler_delay=args.fault_straggler_delay,
+                               corrupt_mode=args.fault_corrupt_mode)
     return ExperimentConfig(
+        faults=faults,
+        checkpoint_every=args.checkpoint_every,
         users_count=args.users_count,
         mal_prop=args.mal_prop,
         dataset=args.dataset,
@@ -378,7 +421,11 @@ def main(argv=None):
             import numpy as np
 
             ckpt = checkpointer or Checkpointer(cfg)
-            path = args.resume if args.resume != "auto" else ckpt.path
+            # 'auto' resumes from the newest checkpoint by round —
+            # rotated auto-checkpoints compete with the best-accuracy
+            # one, so a killed run continues from where it actually got.
+            path = (args.resume if args.resume != "auto"
+                    else (ckpt.latest() or ckpt.path))
             if not os.path.exists(path):
                 raise SystemExit(f"--resume: no checkpoint at {path}")
             if path.endswith((".pth.tar", ".pth", ".pt")):
@@ -393,11 +440,19 @@ def main(argv=None):
                     checkpointer.best_acc = ref_acc
                 logger.print(f"Imported reference checkpoint (acc {ref_acc})")
             else:
-                exp.state = ckpt.resume(path)
+                exp.state, extra = ckpt.resume(path, with_extra=True)
+                # Checkpointed fault state (the straggler ring buffer)
+                # comes back too, so a resumed faulted run continues
+                # bit-for-bit.
+                exp.restore_fault_state(extra)
                 if checkpointer is not None:
                     # Don't let the first post-resume eval overwrite a
-                    # better checkpoint (keep_best seeding).
-                    checkpointer.best_acc = float(np.load(path)["accuracy"])
+                    # better checkpoint (keep_best seeding; auto
+                    # checkpoints record accuracy -1, so the best
+                    # checkpoint's own accuracy still wins).
+                    checkpointer.best_acc = max(
+                        float(np.load(path)["accuracy"]),
+                        checkpointer.load_best_acc())
             if exp.shardings is not None:
                 # Restore the planned state sharding the engine set at init
                 # (state only — data placement was already decided at init,

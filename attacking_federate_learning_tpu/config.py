@@ -32,6 +32,76 @@ FADING_RATES = {CIFAR10: 2000.0, MNIST: 10000.0, CIFAR100: 1500.0,
 
 
 @dataclasses.dataclass
+class FaultConfig:
+    """Deterministic client-side fault model (core/faults.py).
+
+    Every rate is a per-client, per-round probability drawn from a PRNG
+    keyed on ``(seed, round)`` — the schedule is a pure function of the
+    config, so two runs (or a run and its resumed half) inject byte-
+    identical faults, and a host-side replay of the draw reproduces the
+    exact injected counts (tools/fault_matrix.py validates emitted
+    'fault' events against that replay).
+
+    Fault kinds (applied to the SUBMITTED update matrix, after the
+    attack seam — the attack owns rows [0, f); corruption is restricted
+    to honest rows so the two threat models never alias):
+
+    - ``dropout``: the client returns no update this round.  Its row is
+      zeroed and excluded from aggregation via the quarantine mask.
+    - ``straggler``: the client submits the gradient it computed
+      ``straggler_delay`` rounds ago (carried in a fixed-shape ring
+      buffer inside the fused round program).  Stale updates are valid
+      — they are aggregated, not quarantined.
+    - ``corrupt``: an honest client's row is damaged in flight —
+      ``'nan'``/``'inf'`` make it non-finite (caught and quarantined
+      pre-aggregation), ``'scale'`` multiplies it by ``corrupt_scale``
+      (finite garbage: what the robust aggregation itself — or, failing
+      that, the divergence watchdog — must absorb).
+
+    The watchdog fields govern server-side graceful degradation
+    (core/engine.py): at span boundaries a non-finite or norm-exploded
+    server state triggers a rollback to the last good auto-checkpoint
+    (cfg.checkpoint_every) instead of an abort, at most
+    ``max_rollbacks`` times.
+    """
+
+    dropout: float = 0.0
+    straggler: float = 0.0
+    corrupt: float = 0.0
+    straggler_delay: int = 1     # rounds of staleness (ring-buffer depth)
+    corrupt_mode: str = "nan"    # 'nan' | 'inf' | 'scale'
+    corrupt_scale: float = 1e30  # multiplier for corrupt_mode='scale'
+    watchdog: bool = True        # divergence watchdog + rollback
+    watchdog_norm: float = 1e8   # ||weights|| explosion threshold
+    max_rollbacks: int = 3       # rollback attempts before aborting
+    seed: Optional[int] = None   # None -> derived from the experiment seed
+
+    def __post_init__(self):
+        for name in ("dropout", "straggler", "corrupt"):
+            v = getattr(self, name)
+            if not (0.0 <= v < 1.0):
+                raise ValueError(
+                    f"fault {name} rate must be in [0, 1), got {v}")
+        if self.straggler_delay < 1:
+            raise ValueError(
+                f"straggler_delay must be >= 1, got {self.straggler_delay}")
+        if self.corrupt_mode not in ("nan", "inf", "scale"):
+            raise ValueError(
+                f"corrupt_mode must be 'nan', 'inf' or 'scale', "
+                f"got {self.corrupt_mode!r}")
+        if self.watchdog_norm <= 0:
+            raise ValueError(
+                f"watchdog_norm must be > 0, got {self.watchdog_norm}")
+        if self.max_rollbacks < 0:
+            raise ValueError(
+                f"max_rollbacks must be >= 0, got {self.max_rollbacks}")
+
+    @property
+    def enabled(self) -> bool:
+        return (self.dropout > 0 or self.straggler > 0 or self.corrupt > 0)
+
+
+@dataclasses.dataclass
 class ExperimentConfig:
     # --- topology -------------------------------------------------------
     users_count: int = 10            # reference main.py:118
@@ -244,6 +314,19 @@ class ExperimentConfig:
     collect_metadata: bool = False
     metadata_fraction: float = 0.11  # reference user.py:65 test_size=0.11
 
+    # --- faults & recovery (core/faults.py; ARCHITECTURE.md) ------------
+    # None (the default) is the zero-fault reference path: the compiled
+    # round program is bit-identical to the pre-fault-subsystem one.  A
+    # FaultConfig (or an equivalent dict, coerced below) with any rate
+    # > 0 turns on in-jit deterministic fault injection + the
+    # pre-aggregation quarantine mask + the divergence watchdog.
+    faults: Optional[FaultConfig] = None
+    # Auto-checkpoint cadence in rounds (0 = off): the engine writes a
+    # rotated, atomically-replaced checkpoint-auto-<round>.npz every N
+    # rounds (utils/checkpoint.py) — the rollback target for the
+    # watchdog and the --resume target after a kill.
+    checkpoint_every: int = 0
+
     # --- observability --------------------------------------------------
     # Per-round structured diagnostics (gradient-norm stats, aggregate
     # norm, faded lr) written to the JSONL log.  The reference logs only
@@ -330,6 +413,14 @@ class ExperimentConfig:
             raise ValueError(
                 f"median_impl must be 'xla' or 'host', "
                 f"got {self.median_impl!r}")
+        if isinstance(self.faults, dict):
+            # Checkpoint-JSON round trips and kwargs-style callers hand
+            # a plain dict; coerce so every consumer sees a FaultConfig.
+            self.faults = FaultConfig(**self.faults)
+        if self.checkpoint_every < 0:
+            raise ValueError(
+                f"checkpoint_every must be >= 0, got "
+                f"{self.checkpoint_every}")
         if self.local_steps < 1:
             raise ValueError(
                 f"local_steps must be >= 1, got {self.local_steps}")

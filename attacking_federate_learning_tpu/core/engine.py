@@ -105,6 +105,19 @@ class FederatedExperiment:
             self.m, self.m_mal = self.n, self.f
         # The defense only ever sees the round cohort.
         check_defense_args(cfg.defense, self.m, self.m_mal)
+        # Fault-injection subsystem (core/faults.py): None is the
+        # zero-fault reference path — no fault state, no mask threading,
+        # the compiled round program is bit-identical to the
+        # pre-fault-subsystem one.
+        if cfg.faults is not None and cfg.faults.enabled:
+            from attacking_federate_learning_tpu.core.faults import (
+                check_fault_support, fault_key
+            )
+            check_fault_support(cfg)
+            self.faults = cfg.faults
+            self._fault_key = fault_key(cfg)
+        else:
+            self.faults = None
         self._part_key = jax.random.key(cfg.seed ^ 0x9A47)
         if shardings is None and cfg.mesh_shape is not None:
             from attacking_federate_learning_tpu.parallel.mesh import make_plan
@@ -150,6 +163,14 @@ class FederatedExperiment:
         params0 = self.model.init(k_init)
         self.flat = make_flattener(params0)
         self.state = init_server_state(self.flat.ravel(params0))
+        if self.faults is not None:
+            from attacking_federate_learning_tpu.core.faults import (
+                init_fault_state
+            )
+            self._fault_state = init_fault_state(self.faults, self.m,
+                                                 self.flat.dim)
+        else:
+            self._fault_state = None
 
         shards = make_shards(cfg.partition, self.dataset.train_y, self.n,
                              cfg.seed, cfg.dirichlet_alpha)
@@ -449,15 +470,20 @@ class FederatedExperiment:
         return grads
 
     def _aggregate_impl(self, state: ServerState, grads, t, agg=None,
-                        telemetry=False):
+                        telemetry=False, mask=None):
         """``agg`` pre-empts the defense call — the Krum-telemetry round
         computes the selection once and aggregates ``grads[sel]`` rather
         than running the O(n^2 d) distance engine twice.  ``telemetry``
         (static bool) asks the defense for its diagnostics pytree and
-        returns ``(new_state, diag)`` instead of ``new_state``."""
+        returns ``(new_state, diag)`` instead of ``new_state``.
+        ``mask``: the quarantine effective-cohort mask (core/faults.py),
+        threaded into the mask-aware defense kernels; None (the
+        no-fault path) leaves the defense call byte-identical."""
         ddiag = {}
         if agg is None:
             kw = {}
+            if mask is not None:
+                kw["mask"] = mask
             if getattr(self.defense_fn, "needs_round", False):
                 # Round-seeded defenses (DnC's fresh sketches) — the same
                 # attribute seam FLTrust uses for needs_server_grad.
@@ -540,10 +566,31 @@ class FederatedExperiment:
         # the O(n^2 d) distance engine never runs twice per round.  With
         # full telemetry on, the defense itself returns its selection
         # mask from the same single distance computation, so the
-        # pre-emption is unnecessary there.
+        # pre-emption is unnecessary there.  Under fault injection the
+        # pre-emption is off too: the selection depends on the
+        # quarantine mask, and only the defense call carries it.
         diag_select = (self._krum_select_fn
-                       if cfg.log_round_stats and not cfg.telemetry
+                       if (cfg.log_round_stats and not cfg.telemetry
+                           and self.faults is None)
                        else None)
+
+        def inject_and_quarantine(grads, t, fstate):
+            """Fault seam (core/faults.py): inject the round-t faults
+            into the submitted matrix, then mask/zero what the server
+            can detect.  Returns the aggregable matrix, the effective-
+            cohort mask, the new fault state and the per-round counts
+            (fixed-shape scalars, keyed ``fault_*`` so they ride the
+            telemetry plumbing into 'fault' events)."""
+            from attacking_federate_learning_tpu.core.faults import (
+                apply_faults, quarantine
+            )
+            submitted, dropped, fstate2, fstats = apply_faults(
+                grads, t, self._fault_key, fstate, self.faults,
+                self.m_mal)
+            clean, mask, qstats = quarantine(submitted, dropped)
+            return clean, mask, fstate2, {**fstats, **qstats}
+
+        self._inject_and_quarantine = inject_and_quarantine
 
         def attack_envelope(grads, state, t):
             """Pre-attack envelope stats (attacks/base.py seam), keyed
@@ -564,17 +611,27 @@ class FederatedExperiment:
             return tele
 
         if getattr(self.attacker, "fusable", True):
-            def fused_core(state, t, batches=None):
+            def fused_core(state, t, batches=None, fstate=None):
                 grads = self._compute_grads_impl(state, t, batches)
                 tele = (attack_envelope(grads, state, t) if cfg.telemetry
                         else {})
                 grads = self.attacker.apply(grads, self.m_mal,
                                             ctx_for(state, t))
+                # ``grads`` stays the post-attack, PRE-fault matrix from
+                # here on (the nan guard must see what the attacker
+                # crafted — a dropout zeroing a malicious row must not
+                # hide a shadow-train nan); the defense aggregates the
+                # quarantined ``agg_grads``.
+                mask, agg_grads = None, grads
+                if self.faults is not None:
+                    agg_grads, mask, fstate, fstats = (
+                        inject_and_quarantine(grads, t, fstate))
+                    tele = {**tele, **fstats}
                 aux = {}
                 if cfg.telemetry:
                     new_state, ddiag = self._aggregate_impl(
-                        state, grads, t, telemetry=True)
-                    tele = finish_telemetry(tele, grads, ddiag)
+                        state, agg_grads, t, telemetry=True, mask=mask)
+                    tele = finish_telemetry(tele, agg_grads, ddiag)
                     if (self._krum_select_fn is not None
                             and "selection_mask" in ddiag):
                         # Krum's mask is one-hot: its argmax IS the
@@ -587,21 +644,34 @@ class FederatedExperiment:
                         sel = diag_select(grads, self.m, self.m_mal)
                         aux["krum_selected"] = sel
                         agg = grads[sel]
-                    new_state = self._aggregate_impl(state, grads, t,
-                                                     agg=agg)
-                return new_state, grads, aux, tele
+                    new_state = self._aggregate_impl(state, agg_grads, t,
+                                                     agg=agg, mask=mask)
+                return new_state, grads, aux, tele, fstate
 
             def crafted_nonfinite(grads):
                 return (~jnp.isfinite(
                     grads[: self.m_mal].astype(jnp.float32))).any()
 
-            def fused(state, t, batches=None):
-                new_state, grads, aux, tele = fused_core(state, t, batches)
-                diag = (round_diagnostics(grads, new_state, t, aux)
-                        if cfg.log_round_stats else {})
-                bad = (crafted_nonfinite(grads) if self._check_attack_nan
-                       else jnp.asarray(False))
-                return new_state, diag, bad, tele
+            if self.faults is None:
+                def fused(state, t, batches=None):
+                    new_state, grads, aux, tele, _ = fused_core(state, t,
+                                                                batches)
+                    diag = (round_diagnostics(grads, new_state, t, aux)
+                            if cfg.log_round_stats else {})
+                    bad = (crafted_nonfinite(grads)
+                           if self._check_attack_nan
+                           else jnp.asarray(False))
+                    return new_state, diag, bad, tele
+            else:
+                def fused(state, t, fstate, batches=None):
+                    new_state, grads, aux, tele, fstate = fused_core(
+                        state, t, batches, fstate)
+                    diag = (round_diagnostics(grads, new_state, t, aux)
+                            if cfg.log_round_stats else {})
+                    bad = (crafted_nonfinite(grads)
+                           if self._check_attack_nan
+                           else jnp.asarray(False))
+                    return new_state, diag, bad, tele, fstate
 
             def fused_span(state, t0, count):
                 # One device program for `count` rounds: steady-state
@@ -611,7 +681,7 @@ class FederatedExperiment:
                 # so every span length shares one compilation.
                 def body(i, carry):
                     s, bad = carry
-                    s2, grads, _, _ = fused_core(s, t0 + i)
+                    s2, grads, _, _, _ = fused_core(s, t0 + i)
                     if self._check_attack_nan:
                         bad = bad | crafted_nonfinite(grads)
                     return s2, bad
@@ -629,7 +699,7 @@ class FederatedExperiment:
                 # length; the eval cadence yields at most two).
                 def body(carry, i):
                     s, bad = carry
-                    s2, grads, _, tele = fused_core(s, t0 + i)
+                    s2, grads, _, tele, _ = fused_core(s, t0 + i)
                     if self._check_attack_nan:
                         bad = bad | crafted_nonfinite(grads)
                     return (s2, bad), tele
@@ -638,10 +708,39 @@ class FederatedExperiment:
                     body, (state, jnp.asarray(False)), jnp.arange(count))
                 return s, bad, stacked
 
-            self._fused_round = jax.jit(fused, donate_argnums=0)
-            self._fused_span = jax.jit(fused_span, donate_argnums=0)
-            self._tele_span = jax.jit(tele_span, static_argnums=2,
-                                      donate_argnums=0)
+            def fault_span(state, t0, count, fstate):
+                # Fault span: like tele_span (scan, static count, one
+                # program per eval/checkpoint interval) but the carry
+                # additionally threads the fault state (the straggler
+                # ring buffer), and the stacked per-round pytree always
+                # carries at least the 'fault_*' counts — fault events
+                # are emitted per round whether or not cfg.telemetry.
+                def body(carry, i):
+                    s, bad, fs = carry
+                    s2, grads, _, tele, fs = fused_core(s, t0 + i, None,
+                                                        fs)
+                    if self._check_attack_nan:
+                        bad = bad | crafted_nonfinite(grads)
+                    return (s2, bad, fs), tele
+
+                (s, bad, fs), stacked = jax.lax.scan(
+                    body, (state, jnp.asarray(False), fstate),
+                    jnp.arange(count))
+                return s, bad, fs, stacked
+
+            donate = self._donate_kw()
+            if self.faults is None:
+                self._fused_round = jax.jit(fused, **donate)
+                self._fused_span = jax.jit(fused_span, **donate)
+                self._tele_span = jax.jit(tele_span, static_argnums=2,
+                                          **donate)
+            else:
+                # The fault paths never donate (any backend): the fault
+                # state rides the carry and the stacked-scan outputs add
+                # aliasing surface beyond what _donate_kw's CPU rationale
+                # already distrusts.
+                self._fused_round = jax.jit(fused)
+                self._fault_span = jax.jit(fault_span, static_argnums=2)
             self._staged = False
         else:
             self._compute_grads = jax.jit(self._compute_grads_impl)
@@ -657,23 +756,115 @@ class FederatedExperiment:
             eager_host_agg = (jax.default_backend() == "cpu"
                               and self.shardings is None
                               and cfg.defense in ("Krum", "Bulyan")
-                              and cfg.distance_impl in ("auto", "host"))
+                              and cfg.distance_impl in ("auto", "host")
+                              # The host engines have no mask seam
+                              # (core/faults.py): under fault injection
+                              # the jitted aggregate resolves 'auto' to
+                              # 'xla' and threads the quarantine mask.
+                              and self.faults is None)
             self._aggregate = (self._aggregate_impl if eager_host_agg
                                else jax.jit(self._aggregate_impl,
-                                            donate_argnums=0))
+                                            **self._donate_kw()))
+            if self.faults is not None:
+                # Staged rounds cross the host every round anyway; the
+                # fault seam runs as its own small jitted step between
+                # the (host) attack craft and the aggregation.
+                self._fault_step = jax.jit(inject_and_quarantine)
             if cfg.telemetry:
                 # telemetry is a trace-time (static) flag, so the
                 # telemetry aggregate is its own jitted function.
                 agg_tele = functools.partial(self._aggregate_impl,
                                              telemetry=True)
                 self._aggregate_tele = (agg_tele if eager_host_agg
-                                        else jax.jit(agg_tele,
-                                                     donate_argnums=0))
+                                        else jax.jit(
+                                            agg_tele,
+                                            **self._donate_kw()))
             self._staged = True
         self._attack_envelope = attack_envelope
         self._finish_telemetry = finish_telemetry
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _donate_kw():
+        """Server-state donation policy: donate on accelerators (HBM
+        reuse matters there), never on the CPU backend.  This box's
+        jaxlib honors CPU donation with full input/output buffer
+        aliasing, and the combination with zero-copy ``np.asarray``
+        views has produced dangling reads and flaky heap corruption
+        (segfaults/aborts mid-test-suite, clobbered snapshot restores —
+        the seed's recoverable-state failure).  A (d,)-state copy per
+        round is noise on CPU; correctness isn't."""
+        if jax.default_backend() == "cpu":
+            return {}
+        return {"donate_argnums": 0}
+
+    @staticmethod
+    def _host_copy(tree):
+        """Owned host snapshot of a device pytree.  ``np.asarray`` on a
+        CPU-backend jax array can be a zero-copy VIEW of the device
+        buffer; snapshots taken before a donating call must own their
+        memory or the donation clobbers them."""
+        return jax.tree.map(lambda a: np.array(a, copy=True), tree)
+
+    def restore_fault_state(self, extra):
+        """Re-install checkpointed fault-injection state (the straggler
+        ring buffer) after a resume (cli.py --resume / Checkpointer
+        ``extra``) so a resumed faulted run continues bit-for-bit."""
+        if self.faults is None or not extra:
+            return
+        if "stale" in extra:
+            self._fault_state = {"stale": jnp.asarray(extra["stale"])}
+
+    def fault_state_host(self):
+        """Host copy of the fault state for checkpointing (None when
+        faults are off or the state is empty)."""
+        if self.faults is None or not self._fault_state:
+            return None
+        return self._host_copy(self._fault_state)
+
+    def _diverged(self) -> bool:
+        """Divergence watchdog predicate, evaluated at span boundaries
+        (host side, one fetch): non-finite server weights, or a weight
+        norm beyond FaultConfig.watchdog_norm — the signature of
+        unquarantinable garbage (e.g. bit-scaled finite rows) making it
+        through aggregation."""
+        w = np.asarray(self.state.weights)
+        if not np.isfinite(w).all():
+            return True
+        return float(np.linalg.norm(w)) > self.faults.watchdog_norm
+
+    def _rollback(self, logger, epoch, checkpointer):
+        """Roll the engine back to the last good auto-checkpointed state
+        instead of aborting.  Emits a 'fault' event, re-persists the
+        restored state as an on-failure auto-checkpoint, and raises
+        FloatingPointError only once max_rollbacks is exhausted (state
+        still restored first, so catch-and-continue callers hold a
+        finite state)."""
+        self._rollbacks += 1
+        st, fs = self._last_good
+        restored_round = int(st.round)
+        logger.record(kind="fault", round=int(epoch), rolled_back=1,
+                      restored_round=restored_round,
+                      rollbacks_total=self._rollbacks)
+        logger.print(
+            f"!! server state diverged after round {epoch}; rolling "
+            f"back to round {restored_round} "
+            f"(rollback {self._rollbacks}/{self.faults.max_rollbacks})")
+        self.state = (self.shardings.place_state(st)
+                      if self.shardings is not None
+                      else jax.tree.map(jnp.asarray, st))
+        if fs is not None:
+            self._fault_state = jax.tree.map(jnp.asarray, fs)
+        if checkpointer is not None:
+            # On-failure checkpoint: persist the state we rolled back
+            # to, so an external --resume lands on the same round.
+            checkpointer.save_auto(self.state, extra=fs)
+        if self._rollbacks > self.faults.max_rollbacks:
+            raise FloatingPointError(
+                f"server state diverged after round {epoch} and "
+                f"exhausted {self.faults.max_rollbacks} rollbacks "
+                f"(restored to round {restored_round})")
+
     def _raise_if_attack_nan(self, bad):
         """Host side of the crafted-rows nan flag — reference-equivalent
         guard, not message parity: the reference raises
@@ -702,7 +893,7 @@ class FederatedExperiment:
         else:
             self.last_round_stats = None
             self.last_span_telemetry = None
-            pre_span = None
+            pre_span = pre_fstate = None
             if self._check_attack_nan:
                 # The span donates self.state, so when the in-program nan
                 # flag fires the post-nan state is all a caller would have
@@ -710,8 +901,21 @@ class FederatedExperiment:
                 # leaves the last good round behind.  A host snapshot of
                 # the pre-span state (~2 vectors of d) keeps catch-and-
                 # continue callers (benchmarks.py) recoverable.
-                pre_span = jax.tree.map(np.asarray, self.state)
-            if self.cfg.telemetry:
+                # np.array(copy=True), NOT np.asarray: asarray can be a
+                # zero-copy view of the very buffer the span donates,
+                # and a clobbered snapshot restores garbage.
+                pre_span = self._host_copy(self.state)
+                if self.faults is not None:
+                    pre_fstate = self._host_copy(self._fault_state)
+            if self.faults is not None:
+                # Fault spans always scan (the stacked per-round pytree
+                # carries the 'fault_*' counts even without telemetry).
+                self.state, bad, self._fault_state, stacked = (
+                    self._fault_span(self.state,
+                                     jnp.asarray(start, jnp.int32),
+                                     int(count), self._fault_state))
+                self.last_span_telemetry = (int(start), stacked)
+            elif self.cfg.telemetry:
                 self.state, bad, stacked = self._tele_span(
                     self.state, jnp.asarray(start, jnp.int32), int(count))
                 self.last_span_telemetry = (int(start), stacked)
@@ -723,6 +927,9 @@ class FederatedExperiment:
                 self.state = (self.shardings.place_state(pre_span)
                               if self.shardings is not None
                               else jax.tree.map(jnp.asarray, pre_span))
+                if pre_fstate is not None:
+                    self._fault_state = jax.tree.map(jnp.asarray,
+                                                     pre_fstate)
                 self._raise_if_attack_nan(bad)
         return self.state
 
@@ -732,8 +939,13 @@ class FederatedExperiment:
         self.last_round_stats = None
         self.last_round_telemetry = None
         if not self._staged:
-            self.state, diag, bad, tele = self._fused_round(self.state, t,
-                                                            batches)
+            if self.faults is not None:
+                (self.state, diag, bad, tele,
+                 self._fault_state) = self._fused_round(
+                    self.state, t, self._fault_state, batches)
+            else:
+                self.state, diag, bad, tele = self._fused_round(
+                    self.state, t, batches)
             if diag:
                 self.last_round_stats = diag
             if tele:
@@ -745,13 +957,19 @@ class FederatedExperiment:
                     if self.cfg.telemetry else {})
             grads = self.attacker.apply(grads, self.m_mal,
                                         self._ctx_for(self.state, t))
+            mask = None
+            if self.faults is not None:
+                grads, mask, self._fault_state, fstats = self._fault_step(
+                    grads, t, self._fault_state)
+                tele = {**tele, **fstats}
             aux = {}
             if self.cfg.telemetry:
                 # The defense returns its own diagnostics (single
                 # distance computation; the Krum mask marks the
                 # aggregated row by construction).
                 self.state, ddiag = self._aggregate_tele(self.state,
-                                                         grads, t)
+                                                         grads, t,
+                                                         mask=mask)
                 tele = self._finish_telemetry(tele, grads, ddiag)
                 if (self._krum_select_fn is not None
                         and "selection_mask" in ddiag):
@@ -761,14 +979,18 @@ class FederatedExperiment:
             else:
                 agg = None
                 if (self.cfg.log_round_stats
-                        and self._krum_select_fn is not None):
+                        and self._krum_select_fn is not None
+                        and self.faults is None):
                     # Eager selection (same knobs as the defense),
                     # aggregate the selected row directly — single
                     # distance computation, same as the fused path.
                     sel = self._krum_select_fn(grads, self.m, self.m_mal)
                     aux["krum_selected"] = sel
                     agg = grads[sel]
-                self.state = self._aggregate(self.state, grads, t, agg)
+                self.state = self._aggregate(self.state, grads, t, agg,
+                                             mask=mask)
+                if tele:
+                    self.last_round_telemetry = tele
             if self.cfg.log_round_stats:
                 self.last_round_stats = self._round_diagnostics(
                     grads, self.state, t, aux)
@@ -776,17 +998,25 @@ class FederatedExperiment:
 
     def _emit_round_telemetry(self, logger, t, tele):
         """Write one round's telemetry (host values) as 'defense' and
-        'attack' events; track Krum winners for the end-of-run
-        selection histogram."""
-        defense_fields, attack_fields = {}, {}
+        'attack' events (cfg.telemetry) and its 'fault_*' counts as a
+        'fault' event (fault injection — emitted with or without
+        telemetry); track Krum winners for the end-of-run selection
+        histogram."""
+        defense_fields, attack_fields, fault_fields = {}, {}, {}
         for k, v in tele.items():
             val = _jsonable(v)
             if k.startswith("attack_"):
                 attack_fields[k[len("attack_"):]] = val
+            elif k.startswith("fault_"):
+                fault_fields[k[len("fault_"):]] = int(val)
             elif k.startswith("defense_"):
                 defense_fields[k[len("defense_"):]] = val
             else:
                 defense_fields[k] = val  # population stats
+        if fault_fields:
+            logger.record(kind="fault", round=int(t), **fault_fields)
+        if not self.cfg.telemetry:
+            return
         logger.record(kind="defense", round=int(t),
                       defense=self.cfg.defense,
                       malicious_count=self.m_mal, **defense_fields)
@@ -871,17 +1101,36 @@ class FederatedExperiment:
         # device program (run_span); eval cadence is identical either way.
         use_spans = (not self._staged and not cfg.log_round_stats
                      and timer is None and not self._streaming)
+        ckpt_every = cfg.checkpoint_every
+        watchdog_on = self.faults is not None and self.faults.watchdog
+        self._rollbacks = 0
+        if watchdog_on or ckpt_every:
+            # Last-good snapshot: the rollback target until the first
+            # auto-checkpoint boundary replaces it.
+            self._last_good = (self._host_copy(self.state),
+                               self.fault_state_host())
         epoch = int(self.state.round)
         while epoch < cfg.epochs:
             if use_spans:
-                # Advance to the next eval boundary in one device program.
+                # Advance to the next eval boundary in one device
+                # program; auto-checkpoint boundaries clip the span too
+                # (a span must not run past its own checkpoint cadence).
                 if epoch % cfg.test_step == 0:
                     boundary = epoch
                 else:
                     boundary = min((epoch // cfg.test_step + 1)
                                    * cfg.test_step, cfg.epochs - 1)
+                if ckpt_every:
+                    # Same boundary quirk as the eval cadence above: at
+                    # a checkpoint epoch the span is one round, so the
+                    # save below runs right after it.
+                    boundary = min(boundary,
+                                   epoch if epoch % ckpt_every == 0
+                                   else (epoch // ckpt_every + 1)
+                                   * ckpt_every)
                 self.run_span(epoch, boundary - epoch + 1)
-                if cfg.telemetry and self.last_span_telemetry is not None:
+                if ((cfg.telemetry or self.faults is not None)
+                        and self.last_span_telemetry is not None):
                     # ONE host fetch per eval interval: the whole stacked
                     # telemetry pytree comes over at the eval boundary.
                     t0, stacked = self.last_span_telemetry
@@ -899,11 +1148,21 @@ class FederatedExperiment:
                     logger.record(kind="round", round=epoch,
                                   **{k: float(v) for k, v in
                                      self.last_round_stats.items()})
-                if cfg.telemetry and self.last_round_telemetry is not None:
+                if ((cfg.telemetry or self.faults is not None)
+                        and self.last_round_telemetry is not None):
                     self._emit_round_telemetry(
                         logger, epoch,
                         jax.tree.map(np.asarray,
                                      self.last_round_telemetry))
+
+            if watchdog_on and self._diverged():
+                # Graceful degradation: restore the last good state and
+                # re-run from there instead of aborting (bounded by
+                # max_rollbacks); the eval below never sees the
+                # diverged weights.
+                self._rollback(logger, epoch, checkpointer)
+                epoch = int(self.state.round)
+                continue
 
             if epoch % cfg.test_step == 0 or epoch == cfg.epochs - 1:
                 # The lambda reads `correct` after the block assigns it, so
@@ -922,6 +1181,16 @@ class FederatedExperiment:
                                                  logger=logger, tag="POST")
                     logger.record(kind="asr", round=epoch,
                                   attack_success_rate=float(asr))
+            if ckpt_every and epoch % ckpt_every == 0:
+                # Periodic auto-checkpoint (atomic + rotated,
+                # utils/checkpoint.py) — the watchdog above has already
+                # certified this state, so it also becomes the new
+                # in-memory last-good rollback target.
+                self._last_good = (self._host_copy(self.state),
+                                   self.fault_state_host())
+                if checkpointer is not None:
+                    checkpointer.save_auto(self.state,
+                                           extra=self._last_good[1])
             epoch += 1
 
         if self.cfg.telemetry:

@@ -1,0 +1,177 @@
+"""In-jit fault injection and the pre-aggregation quarantine.
+
+The reference simulator (and the faithful rebuild) assumes every client
+returns a finite, fresh gradient every round; the only failure either
+survives is the backdoor shadow-train nan guard.  Real cohorts are
+dominated by dropped clients, stragglers and damaged updates, so this
+module gives the engine a DETERMINISTIC fault model that runs *inside*
+the fused round program (core/engine.py):
+
+- Every draw flows from a PRNG key folded with the round index, so the
+  schedule is a pure function of ``(FaultConfig, seed, round)``:
+  identical across runs, across resume boundaries, and across the
+  host-side replay (:func:`fault_masks` runs unmodified under trace and
+  eagerly) that tools/fault_matrix.py and the tests use to verify the
+  emitted 'fault' events against the injected schedule.
+- All shapes are fixed.  Dropout zeroes a row and flips its quarantine
+  bit; stragglers read a ``(delay, m, d)`` ring buffer carried through
+  the scanned span; corruption overwrites honest rows in place.  The
+  no-fault path is untouched — the engine only threads fault state when
+  ``cfg.faults`` is enabled, so the zero-fault HLO stays bit-identical.
+
+Seams:
+
+- :func:`apply_faults` sits on the SUBMITTED update matrix, after the
+  attack seam.  The attack owns rows [0, f); corruption draws from
+  honest rows only, so the Byzantine threat model and the benign-fault
+  model never alias.
+- :func:`quarantine` is the server-side half: it masks non-finite and
+  dropped rows, zeroes them (so the distance engines never see
+  NaN/Inf), and hands the effective-cohort mask to the mask-aware
+  defense kernels (defenses/kernels.py ``mask=`` seam).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# Defenses that accept the quarantine mask (the ``mask=`` kwarg).  The
+# engine refuses fault injection with any other defense up front — a
+# defense that silently averaged zeroed dropout rows would corrupt the
+# aggregate, which is exactly the failure mode this subsystem exists to
+# prevent.
+MASK_AWARE_DEFENSES = ("NoDefense", "Krum", "TrimmedMean", "Bulyan",
+                       "Median")
+
+
+def check_fault_support(cfg):
+    """Fail fast on configs the fault model cannot honor (engine init)."""
+    if cfg.defense not in MASK_AWARE_DEFENSES:
+        raise ValueError(
+            f"faults need a mask-aware defense {MASK_AWARE_DEFENSES}, "
+            f"got {cfg.defense!r} (the quarantine mask must reach the "
+            f"kernel; defenses/kernels.py)")
+    if cfg.faults.straggler > 0 and cfg.participation < 1.0:
+        raise ValueError(
+            "straggler faults need participation=1.0: the stale ring "
+            "buffer is indexed by cohort row, and under partial "
+            "participation rows are different clients each round")
+    host_impls = [
+        ("distance_impl", cfg.distance_impl),
+        ("trimmed_mean_impl", cfg.trimmed_mean_impl),
+        ("median_impl", cfg.median_impl),
+        ("bulyan_selection_impl", cfg.bulyan_selection_impl),
+        ("bulyan_trim_impl", cfg.bulyan_trim_impl),
+    ]
+    for name, val in host_impls:
+        if val == "host":
+            raise ValueError(
+                f"faults are incompatible with {name}='host': the host "
+                f"engines return only aggregates/indices and have no "
+                f"mask seam (defenses/host.py)")
+
+
+def fault_key(cfg):
+    """The fault subsystem's own key stream, derived from (but distinct
+    from) the experiment seed unless FaultConfig.seed overrides it."""
+    seed = cfg.faults.seed if cfg.faults.seed is not None else cfg.seed
+    return jax.random.key(seed ^ 0x0FA7175)
+
+
+def init_fault_state(faults, m, d):
+    """Fixed-shape device state threaded through the round program.
+
+    ``{'stale': (delay, m, d) f32}`` ring buffer when stragglers are
+    configured (slot ``t % delay`` holds the cohort's submissions from
+    round ``t - delay``), else an empty pytree — the engine passes it
+    through jit either way only when faults are enabled.
+    """
+    if faults.straggler > 0:
+        return {"stale": jnp.zeros((faults.straggler_delay, m, d),
+                                   jnp.float32)}
+    return {}
+
+
+def fault_masks(key, t, m, m_mal, faults):
+    """The round-t injection schedule: three (m,) bool masks.
+
+    Pure in ``(key, t)`` — runs identically traced (inside the fused
+    round) and eagerly (the host replay that validates emitted events).
+    Dropout wins over the other two; corruption draws from honest rows
+    only; stragglers are suppressed while the ring buffer is cold
+    (t < delay), so the counts always describe faults actually applied.
+    """
+    kt = jax.random.fold_in(key, t)
+    k_drop, k_stale, k_corr = jax.random.split(kt, 3)
+    drop = jax.random.uniform(k_drop, (m,)) < faults.dropout
+    stale = (jax.random.uniform(k_stale, (m,)) < faults.straggler) & ~drop
+    stale = stale & (t >= faults.straggler_delay)
+    honest = jnp.arange(m) >= m_mal
+    corrupt = ((jax.random.uniform(k_corr, (m,)) < faults.corrupt)
+               & ~drop & ~stale & honest)
+    return drop, stale, corrupt
+
+
+def apply_faults(grads, t, key, state, faults, m_mal):
+    """Inject the round-t faults into the submitted (m, d) matrix.
+
+    Returns ``(faulted, dropped, new_state, stats)``.  ``dropped`` is
+    the (m,) bool dropout mask (rows already zeroed — :func:`quarantine`
+    folds it into the effective-cohort mask); ``stats`` are fixed-shape
+    scalar counts keyed ``fault_*`` so they ride the engine's telemetry
+    plumbing into per-round 'fault' events.
+    """
+    m = grads.shape[0]
+    drop, stale, corrupt = fault_masks(key, t, m, m_mal, faults)
+
+    if faults.straggler > 0:
+        # Read the round t-delay submissions BEFORE overwriting the slot
+        # with this round's fresh (pre-fault) matrix: a straggler
+        # submits what it computed delay rounds ago; what it computed
+        # THIS round enters the buffer for round t+delay.
+        slot = jnp.mod(t, faults.straggler_delay)
+        old = lax.dynamic_index_in_dim(state["stale"], slot, 0,
+                                       keepdims=False)
+        new_state = {"stale": lax.dynamic_update_index_in_dim(
+            state["stale"], grads.astype(jnp.float32), slot, 0)}
+        grads = jnp.where(stale[:, None], old.astype(grads.dtype), grads)
+    else:
+        new_state = state
+
+    if faults.corrupt > 0:
+        if faults.corrupt_mode == "scale":
+            grads = grads * jnp.where(corrupt, faults.corrupt_scale,
+                                      1.0).astype(grads.dtype)[:, None]
+        else:
+            bad = {"nan": jnp.nan, "inf": jnp.inf}[faults.corrupt_mode]
+            grads = jnp.where(corrupt[:, None],
+                              jnp.asarray(bad, grads.dtype), grads)
+
+    grads = jnp.where(drop[:, None], jnp.zeros((), grads.dtype), grads)
+    stats = {
+        "fault_injected_dropout": jnp.sum(drop).astype(jnp.int32),
+        "fault_injected_straggler": jnp.sum(stale).astype(jnp.int32),
+        "fault_injected_corrupt": jnp.sum(corrupt).astype(jnp.int32),
+    }
+    return grads, drop, new_state, stats
+
+
+def quarantine(grads, dropped):
+    """Pre-aggregation quarantine: the server masks what it can SEE.
+
+    Non-finite rows (corrupt in flight) and dropped rows (no update)
+    are excluded from the effective cohort and zeroed so the distance
+    engines stay NaN-free; everything else — including stale and
+    bit-scaled-but-finite rows — is the robust aggregation's problem,
+    exactly as in a real deployment.  Returns ``(clean, mask, stats)``
+    with ``mask`` (m,) bool True for aggregable rows.
+    """
+    finite = jnp.isfinite(grads.astype(jnp.float32)).all(axis=1)
+    mask = finite & ~dropped
+    clean = jnp.where(mask[:, None], grads, jnp.zeros((), grads.dtype))
+    stats = {"fault_quarantined":
+             (grads.shape[0] - jnp.sum(mask)).astype(jnp.int32)}
+    return clean, mask, stats

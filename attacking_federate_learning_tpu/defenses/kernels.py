@@ -130,6 +130,47 @@ def _host_defense(host_fn, users_grads, users_count, corrupted_count,
                              users_grads.astype(jnp.float32))
 
 
+def masked_median(users_grads, mask):
+    """Median along the client axis over the alive rows only.
+
+    The alive count is data-dependent (traced), but shapes stay fixed:
+    dead rows sort to the end (+inf sentinel) and the median gathers
+    the middle one/two of the first ``e`` sorted entries with dynamic
+    indices.  With an all-true mask this computes exactly
+    ``jnp.median`` (same sort, same mean-of-two-middles).
+    """
+    vals = jnp.where(mask[:, None], users_grads, _INF)
+    srt = jnp.sort(vals, axis=0)
+    e = jnp.sum(mask).astype(jnp.int32)
+    lo = jnp.take(srt, (e - 1) // 2, axis=0)
+    hi = jnp.take(srt, e // 2, axis=0)
+    return (lo + hi) / 2
+
+
+def masked_trimmed_mean_of(users_grads, mask, number_to_consider):
+    """Mask-aware median-anchored trimmed mean (the quarantine seam).
+
+    Same estimator as :func:`trimmed_mean_of` over the alive rows only:
+    the anchor is the alive median, dead rows sort last (+inf deviation
+    key), and the keep count ``number_to_consider`` may be traced
+    (e - f - 1 with e the data-dependent alive count).  Fixed shapes
+    throughout; the keep boundary is a rank comparison instead of a
+    static slice.
+    """
+    n = users_grads.shape[0]
+    med = masked_median(users_grads, mask)
+    dev = users_grads - med[None, :]
+    key = jnp.where(mask[:, None], jnp.abs(dev), _INF)
+    order = jnp.argsort(key, axis=0, stable=True)   # dead rows last
+    sdev = jnp.take_along_axis(dev, order, axis=0)
+    # Degenerate cohorts (too many quarantined rows for the trim) keep
+    # at least one value instead of dividing by zero — the divergence
+    # watchdog, not a NaN aggregate, is the recovery path.
+    k = jnp.maximum(number_to_consider, 1)
+    keep = jnp.arange(n)[:, None] < k
+    return jnp.sum(jnp.where(keep, sdev, 0.0), axis=0) / k + med
+
+
 def population_telemetry(users_grads):
     """Per-client update norms and cosine-to-mean — the population view
     the server can always observe (Bonawitz et al.: the update
@@ -143,9 +184,17 @@ def population_telemetry(users_grads):
 
 
 @DEFENSES.register("NoDefense")
-def no_defense(users_grads, users_count, corrupted_count, telemetry=False):
-    """Plain FedAvg mean (reference defences.py:13-14)."""
-    agg = jnp.mean(users_grads, axis=0)
+def no_defense(users_grads, users_count, corrupted_count, telemetry=False,
+               mask=None):
+    """Plain FedAvg mean (reference defences.py:13-14).  ``mask`` (the
+    quarantine seam, core/faults.py): mean over the alive rows only —
+    a zeroed dropout row must not drag the average toward zero."""
+    if mask is None:
+        agg = jnp.mean(users_grads, axis=0)
+    else:
+        e = jnp.maximum(jnp.sum(mask), 1)
+        agg = jnp.sum(jnp.where(mask[:, None], users_grads, 0.0),
+                      axis=0) / e
     if not telemetry:
         return agg
     return agg, {}
@@ -256,39 +305,56 @@ def _host_krum_index(users_grads, users_count, corrupted_count,
 
 def _krum_scores_and_index(users_grads, users_count, corrupted_count,
                            paper_scoring, method, distance_impl, D,
-                           distance_dtype):
+                           distance_dtype, mask=None):
     """(scores-or-None, winner index) behind both :func:`krum_select`
     and the telemetry path.  Scores are ``None`` on the host engine —
     it returns only the scalar index (defenses/host.py), so telemetry
-    fills that slot with NaN instead of paying a second (n,) marshal."""
+    fills that slot with NaN instead of paying a second (n,) marshal.
+
+    ``mask`` (the quarantine seam, core/faults.py): dead rows are
+    excluded from every score (their distance entries mask to +inf, the
+    per-row keep count k follows the data-dependent alive pool e - f)
+    and can never win — fixed shapes, scoring forced onto the exact
+    'sort' evaluator (the topk complement identity assumes the static
+    pool)."""
     if D is None:
         impl = resolve_distance_impl(distance_impl, users_count,
                                      users_grads)
         if impl == "host":
+            if mask is not None:
+                raise ValueError(
+                    "mask-aware Krum needs a score-returning engine; "
+                    "the host engine returns only the winner index "
+                    "(defenses/host.py)")
             return None, _host_krum_index(users_grads, users_count,
                                           corrupted_count, paper_scoring)
         D = _distances_for(users_grads, impl, distance_dtype)
-    scores = _krum_scores(D, users_count, corrupted_count,
-                          paper_scoring=paper_scoring, method=method)
+    if mask is not None:
+        scores = _krum_scores(D, jnp.sum(mask), corrupted_count,
+                              alive=mask, paper_scoring=paper_scoring,
+                              method="sort")
+    else:
+        scores = _krum_scores(D, users_count, corrupted_count,
+                              paper_scoring=paper_scoring, method=method)
     return scores, jnp.argmin(scores)
 
 
 def krum_select(users_grads, users_count, corrupted_count,
                 paper_scoring=False, method="sort", distance_impl="xla",
-                D=None, distance_dtype=None):
+                D=None, distance_dtype=None, mask=None):
     """Index of the Krum winner (reference ``krum(..., return_index=True)``,
     defences.py:39-40).  :func:`krum` is defined through this, so the
     selection the engine's round diagnostics report is — by construction —
     the client the defense aggregated, for every distance engine."""
     return _krum_scores_and_index(users_grads, users_count, corrupted_count,
                                   paper_scoring, method, distance_impl, D,
-                                  distance_dtype)[1]
+                                  distance_dtype, mask=mask)[1]
 
 
 @DEFENSES.register("Krum")
 def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
          method="sort", distance_impl="xla", D=None, distance_dtype=None,
-         telemetry=False):
+         telemetry=False, mask=None):
     """Krum selection (reference defences.py:23-42): the single gradient
     whose summed distance to its k nearest peers is minimal.
 
@@ -304,6 +370,10 @@ def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
     one-hot f32, 'scores': (n,) f32 Krum scores}`` — the same single
     distance computation, so the mask provably marks the aggregated row
     (NaN scores on the scalar-index host engine).
+
+    ``mask`` (the quarantine seam, core/faults.py): quarantined rows
+    can never win selection and are excluded from every row's score;
+    the winner is the Krum choice of the alive sub-cohort.
     """
     if not telemetry:
         return users_grads[krum_select(users_grads, users_count,
@@ -311,10 +381,11 @@ def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
                                        paper_scoring=paper_scoring,
                                        method=method,
                                        distance_impl=distance_impl, D=D,
-                                       distance_dtype=distance_dtype)]
+                                       distance_dtype=distance_dtype,
+                                       mask=mask)]
     scores, idx = _krum_scores_and_index(
         users_grads, users_count, corrupted_count, paper_scoring, method,
-        distance_impl, D, distance_dtype)
+        distance_impl, D, distance_dtype, mask=mask)
     n = users_grads.shape[0]
     scores_out = (jnp.full((n,), jnp.nan, jnp.float32) if scores is None
                   else scores.astype(jnp.float32))
@@ -370,7 +441,7 @@ def trimmed_mean_of(users_grads, number_to_consider, impl="xla",
 
 @DEFENSES.register("TrimmedMean")
 def trimmed_mean(users_grads, users_count, corrupted_count, impl="xla",
-                 telemetry=False):
+                 telemetry=False, mask=None):
     """Reference defences.py:44-52; keeps n - f - 1 coordinates.
 
     ``impl='host'`` (opt-in, config ``trimmed_mean_impl``) routes to the
@@ -382,7 +453,27 @@ def trimmed_mean(users_grads, users_count, corrupted_count, impl="xla",
     mean differs from XLA by summation-order ulps — which is why it is
     NOT auto-dispatched: the staged/fused bit-identity invariant
     (tests/test_engine.py::test_backdoor_fused_equals_staged) holds
-    only when both modes run the same kernel."""
+    only when both modes run the same kernel.
+
+    ``mask`` (the quarantine seam, core/faults.py): the estimator runs
+    over the alive rows only — alive median anchor, keep count
+    e - f - 1 with e the data-dependent alive count (the trim budget
+    shrinks with the cohort, it is not spent on quarantined rows)."""
+    if mask is not None:
+        if impl == "host":
+            raise ValueError(
+                "mask-aware TrimmedMean has no host kernel "
+                "(defenses/host.py is maskless); use impl='xla'")
+        n = users_grads.shape[0]
+        e = jnp.sum(mask)
+        agg = masked_trimmed_mean_of(users_grads, mask,
+                                     e - corrupted_count - 1)
+        if not telemetry:
+            return agg
+        return agg, {"kept_fraction": jnp.full((n,), jnp.nan, jnp.float32),
+                     "trim_fraction":
+                     (1.0 - (e - corrupted_count - 1) / jnp.maximum(e, 1)
+                      ).astype(jnp.float32)}
     number_to_consider = users_grads.shape[0] - corrupted_count - 1
     return trimmed_mean_of(users_grads, number_to_consider, impl=impl,
                            telemetry=telemetry)
@@ -460,7 +551,7 @@ def _bulyan_diag(n, selected, Dm, users_count, corrupted_count,
 def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
            method="sort", distance_impl="xla", D=None, batch_select=1,
            distance_dtype=None, selection_impl="xla", trim_impl="xla",
-           telemetry=False):
+           telemetry=False, mask=None):
     """Bulyan (reference defences.py:55-70): iteratively Krum-select
     n - 2f gradients (removing each winner from the pool, with the pool
     size — but not f — shrinking), then trim-mean the selection with
@@ -515,7 +606,15 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
     hybrid.
 
     ``telemetry=True`` additionally returns the :func:`_bulyan_diag`
-    pytree (multi-hot selection mask + initial-pool Krum scores)."""
+    pytree (multi-hot selection mask + initial-pool Krum scores).
+
+    ``mask`` (the quarantine seam, core/faults.py): the selection pool
+    starts from the alive rows; the SELECTED set keeps its static
+    ``set_size`` shape (fixed shapes everywhere), with quarantined rows
+    admitted only after every alive row (finite below-+inf sentinel) and
+    excluded again from the final trimmed mean by an alive sub-mask —
+    so a quarantined row can pad the selection buffer but never touches
+    the aggregate."""
     n, _ = users_grads.shape
     f = corrupted_count
     set_size = users_count - 2 * f
@@ -533,10 +632,19 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
         return trimmed_mean_of(selection, number_to_consider,
                                impl=trim_impl)
     q = min(q, set_size)
+    if mask is not None and selection_impl == "host":
+        raise ValueError(
+            "mask-aware Bulyan is incompatible with "
+            "selection_impl='host': the native selection engine has no "
+            "mask seam (native/bulyan_select.cpp)")
     if D is None:
         impl = resolve_distance_impl(distance_impl, users_count,
                                      users_grads)
         if impl == "host":
+            if mask is not None:
+                raise ValueError(
+                    "mask-aware Bulyan has no full-host engine "
+                    "(defenses/host.py is maskless)")
             from attacking_federate_learning_tpu.defenses.host import (
                 host_bulyan
             )
@@ -569,6 +677,73 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
             return agg
         return agg, _bulyan_diag(n, selected, Dm, users_count,
                                  corrupted_count, paper_scoring, method)
+
+    if mask is not None:
+        # Mask-aware selection, fixed shapes: the ``selected`` buffer
+        # stays (set_size,) whatever the alive count.  Three-level
+        # eligibility ladder per trip — alive & unselected rows compete
+        # on real scores; dead unselected rows carry a finite
+        # below-+inf sentinel (picked only once the alive pool is
+        # exhausted, deterministically by lowest index); already-
+        # selected rows sit at +inf and can never be re-picked.  Dead
+        # rows that do pad the selection are excluded from the final
+        # trimmed mean by the alive sub-mask, so they never touch the
+        # aggregate.  (A real score above the 3e38 sentinel would
+        # misorder a pick; finite f32 sums sit well below it outside
+        # deliberately overflowed inputs, which quarantine already
+        # removed.)
+        order_m = jnp.argsort(Dm, axis=1)
+        sortedD_m = jnp.take_along_axis(Dm, order_m, axis=1)
+        finite_m = jnp.isfinite(sortedD_m)
+        trips_m = -(-set_size // q)
+        dead_sentinel = jnp.float32(3e38)
+
+        def body_m(t, carry):
+            remaining, selected = carry
+            alive_pool = remaining & mask
+            # Reference shrinking-pool k, over the ALIVE pool (clamped:
+            # a degenerate cohort keeps at least the nearest neighbor).
+            k = jnp.maximum(jnp.sum(alive_pool) - f
+                            - (2 if paper_scoring else 0), 1)
+            alive_cols = alive_pool[order_m]
+            rank = jnp.cumsum(alive_cols, axis=1)
+            take = alive_cols & (rank <= k) & finite_m
+            scores = jnp.sum(jnp.where(take, sortedD_m, 0.0), axis=1)
+            scores = jnp.where(alive_pool, scores, dead_sentinel)
+            scores = jnp.where(remaining, scores, _INF)
+            _, idxs = lax.top_k(-scores, q)
+            r = jnp.minimum(q, set_size - t * q)
+            live = jnp.arange(q) < r
+            kill = jnp.zeros((n,), bool).at[idxs].set(live)
+            selected = lax.dynamic_update_slice(
+                selected, jnp.where(live, idxs, 0).astype(jnp.int32),
+                (t * q,))
+            return remaining & ~kill, selected
+
+        _, selected = lax.fori_loop(
+            0, trips_m, body_m,
+            (jnp.ones((n,), bool), jnp.zeros((trips_m * q,), jnp.int32)))
+        selected = selected[:set_size]
+        selection = users_grads[selected]
+        # Effective-cohort Bulyan selects e - 2f of the e alive rows.
+        # Alive rows enter ``selected`` first and in exactly the order a
+        # run over the alive sub-matrix would pick them (dead rows only
+        # pad the tail), so clipping to the first e - 2f alive picks
+        # reproduces the shrunk-cohort selection SET inside the static
+        # (set_size,) buffer; the rest is excluded from the trim below.
+        sel_alive = mask[selected]
+        e_set = jnp.sum(mask) - 2 * f
+        sel_mask = sel_alive & (jnp.cumsum(sel_alive) <= e_set)
+        agg = masked_trimmed_mean_of(selection, sel_mask,
+                                     jnp.sum(sel_mask) - 2 * f - 1)
+        if not telemetry:
+            return agg
+        dm = jnp.zeros((n,), jnp.float32).at[selected].set(
+            sel_mask.astype(jnp.float32))
+        scores0 = _krum_scores(Dm, jnp.sum(mask), corrupted_count,
+                               alive=mask, paper_scoring=paper_scoring,
+                               method="sort").astype(jnp.float32)
+        return agg, {"selection_mask": dm, "scores": scores0}
 
     # Presort once for the traced selection loop.
     order = jnp.argsort(Dm, axis=1)

@@ -15,7 +15,7 @@ from attacking_federate_learning_tpu.defenses.kernels import DEFENSES
 
 @DEFENSES.register("Median")
 def median(users_grads, users_count, corrupted_count, impl="xla",
-           telemetry=False):
+           telemetry=False, mask=None):
     """``impl='host'`` (opt-in, config ``median_impl``) routes to the
     native column-blocked kernel (native/bulyan_select.cpp:fl_median) —
     same rationale and same non-auto-dispatch rule as
@@ -24,7 +24,26 @@ def median(users_grads, users_count, corrupted_count, impl="xla",
     ``telemetry=True`` additionally returns ``{'dist_to_agg': (n,)}`` —
     each client's L2 distance to the aggregated median vector, the
     outlier view a coordinate-wise estimator admits (both impls: the
-    distance is computed from the returned aggregate)."""
+    distance is computed from the returned aggregate).
+
+    ``mask`` (the quarantine seam, core/faults.py): the median of the
+    alive rows only (kernels.py:masked_median — fixed shapes, traced
+    alive count)."""
+    if mask is not None:
+        if impl == "host":
+            raise ValueError(
+                "mask-aware Median has no host kernel "
+                "(defenses/host.py is maskless); use impl='xla'")
+        from attacking_federate_learning_tpu.defenses.kernels import (
+            masked_median
+        )
+        agg = masked_median(users_grads, mask)
+        if not telemetry:
+            return agg
+        G = users_grads.astype(jnp.float32)
+        dist = jnp.linalg.norm(G - agg.astype(jnp.float32)[None, :],
+                               axis=1)
+        return agg, {"dist_to_agg": dist}
     if impl == "host":
         from attacking_federate_learning_tpu.defenses.host import (
             host_median

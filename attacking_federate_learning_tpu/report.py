@@ -82,6 +82,33 @@ def selection_concentration(events):
     return out
 
 
+def fault_recovery(events):
+    """Fault/recovery accounting from 'fault' events (core/faults.py +
+    the engine watchdog): total injected per kind, quarantined rows,
+    rounds touched, and every rollback record.  Returns None when the
+    run emitted no fault events (faults off)."""
+    injected = Counter()
+    quarantined = rounds = 0
+    rollbacks = []
+    for e in events:
+        if e.get("kind") != "fault":
+            continue
+        if e.get("rolled_back"):
+            rollbacks.append({"round": e["round"],
+                              "restored_round": e.get("restored_round"),
+                              "rollbacks_total": e.get("rollbacks_total")})
+            continue
+        rounds += 1
+        quarantined += int(e.get("quarantined", 0))
+        for k, v in e.items():
+            if k.startswith("injected_"):
+                injected[k[len("injected_"):]] += int(v)
+    if not rounds and not rollbacks:
+        return None
+    return {"rounds": rounds, "injected": dict(injected),
+            "quarantined": quarantined, "rollbacks": rollbacks}
+
+
 def summarize_run(events):
     """One run's report payload from its event list."""
     kinds = Counter(e["kind"] for e in events)
@@ -110,6 +137,9 @@ def summarize_run(events):
     sel = selection_concentration(events)
     if sel:
         out["selection"] = sel
+    faults = fault_recovery(events)
+    if faults:
+        out["faults"] = faults
     hists = [e for e in events if e["kind"] == "selection_hist"]
     if hists:
         out["selection_hist"] = {
@@ -154,6 +184,15 @@ def _print_run(path, s, out):
                if "malicious_picks" in sel else ""))
         hist = "  ".join(f"{k}:{v}" for k, v in sel["histogram"].items())
         out(f"    histogram  {hist}")
+    flt = s.get("faults")
+    if flt:
+        inj = "  ".join(f"{k}:{v}" for k, v in sorted(
+            flt["injected"].items())) or "none"
+        out(f"  faults over {flt['rounds']} rounds: injected [{inj}]  "
+            f"quarantined {flt['quarantined']}")
+        for rb in flt["rollbacks"]:
+            out(f"    rollback at round {rb['round']} -> restored round "
+                f"{rb['restored_round']} (total {rb['rollbacks_total']})")
     if "phases" in s:
         out("  phase timing:")
         for name, row in s["phases"].items():

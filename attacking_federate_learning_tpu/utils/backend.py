@@ -29,6 +29,31 @@ def relay_ports_listening(ports=(8082, 8083, 8087), timeout=2.0):
     return False
 
 
+def relay_ports_listening_retry(ports=None, timeout=1.0, retries=3,
+                                backoff=0.5, sleep=None):
+    """Bounded retry-with-backoff wrapper around the port probe.
+
+    A single short probe misclassifies a slow-but-alive relay (accept
+    queue full, listener mid-restart) as dead, silently benching an
+    accelerator run on CPU.  This probes up to ``retries`` times with
+    doubling backoff (0.5 s then 1 s between the default 3 probes —
+    worst case a few seconds, still bounded) and returns on the first
+    success.  ``sleep`` is injectable for tests; ``ports=None`` keeps
+    the probe's default port set (and its monkeypatchability)."""
+    import time
+
+    sleep = sleep or time.sleep
+    kw = {} if ports is None else {"ports": ports}
+    delay = backoff
+    for attempt in range(max(1, retries)):
+        if relay_ports_listening(timeout=timeout, **kw):
+            return True
+        if attempt + 1 < retries:
+            sleep(delay)
+            delay *= 2
+    return False
+
+
 def _fallback_to_cpu(reason: str):
     print(reason + "; falling back to CPU", file=sys.stderr, flush=True)
     os.environ.update(_BENCH_BACKEND_CHECKED="1", JAX_PLATFORMS="cpu",
@@ -126,8 +151,11 @@ def ensure_live_backend(probe_timeout=240):
     if os.environ.get("_BENCH_BACKEND_CHECKED"):
         return
     if (os.environ.get("PALLAS_AXON_POOL_IPS")
-            and not relay_ports_listening()):
-        _fallback_to_cpu("TPU relay ports closed")
+            and not relay_ports_listening_retry(timeout=2.0)):
+        # Retry-with-backoff: a slow-but-alive relay must not be
+        # misclassified as dead at the one probe that decides the
+        # backend for the whole run.
+        _fallback_to_cpu("TPU relay ports closed (3 probes)")
     try:
         subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],

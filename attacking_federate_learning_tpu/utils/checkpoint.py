@@ -27,47 +27,141 @@ from attacking_federate_learning_tpu.core.server import ServerState
 
 
 class Checkpointer:
+    """Best-accuracy checkpoint (the reference behavior) plus rotated
+    periodic auto-checkpoints (``checkpoint-auto-<round>.npz``) for the
+    engine's fault-recovery path (core/engine.py).
+
+    Every write is ATOMIC: the .npz and its .json sidecar land in a
+    temp file in the same directory and ``os.replace`` into place, so a
+    crash (or the SIGKILL the resume tests simulate) can never leave a
+    torn checkpoint behind.  Auto-checkpoints rotate (``keep_last``),
+    so an aggressive cadence can't fill ``runs/``.
+
+    ``extra``: a dict of named arrays saved alongside the server state
+    — the engine checkpoints its fault-injection state (the straggler
+    ring buffer) here so a resumed faulted run continues bit-for-bit.
+    """
+
+    _AUTO_PREFIX = "checkpoint-auto-"
+
     def __init__(self, cfg, run_dir: Optional[str] = None,
-                 keep_best: bool = True):
+                 keep_best: bool = True, keep_last: int = 3):
         # Directory schema mirrors the reference: runs/<dataset>/
         # (server.py:42).
         self.dir = run_dir or os.path.join(cfg.run_dir, cfg.dataset)
         os.makedirs(self.dir, exist_ok=True)
         self.cfg = cfg
         self.keep_best = keep_best
+        self.keep_last = max(1, int(keep_last))
         self.best_acc = -1.0
 
     @property
     def path(self) -> str:
         return os.path.join(self.dir, "checkpoint.npz")
 
-    def save(self, state: ServerState, accuracy: float, tag: str = None):
+    def _write_atomic(self, path: str, arrays: dict, meta: dict):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        jpath = path.replace(".npz", ".json")
+        jtmp = jpath + ".tmp"
+        with open(jtmp, "w") as f:
+            json.dump(meta, f, indent=1, default=str)
+        os.replace(jtmp, jpath)
+
+    def save(self, state: ServerState, accuracy: float, tag: str = None,
+             extra: Optional[dict] = None):
         if self.keep_best and tag is None and accuracy < self.best_acc:
             # Don't let a later, worse state overwrite the best checkpoint
             # (the reference always overwrites, server.py:40-48).
             return self.path
         path = (os.path.join(self.dir, f"checkpoint-{tag}.npz")
                 if tag else self.path)
-        np.savez(path,
-                 weights=np.asarray(state.weights),
-                 velocity=np.asarray(state.velocity),
-                 round=np.asarray(state.round),
-                 accuracy=np.float32(accuracy))
-        with open(path.replace(".npz", ".json"), "w") as f:
-            json.dump({"accuracy": float(accuracy),
-                       "round": int(state.round),
-                       "config": dataclasses.asdict(self.cfg)}, f, indent=1,
-                      default=str)
-        if self.keep_best and accuracy > self.best_acc:
+        arrays = dict(weights=np.asarray(state.weights),
+                      velocity=np.asarray(state.velocity),
+                      round=np.asarray(state.round),
+                      accuracy=np.float32(accuracy))
+        for k, v in (extra or {}).items():
+            arrays[f"extra_{k}"] = np.asarray(v)
+        self._write_atomic(path, arrays,
+                           {"accuracy": float(accuracy),
+                            "round": int(state.round),
+                            "config": dataclasses.asdict(self.cfg)})
+        if self.keep_best and tag is None and accuracy > self.best_acc:
             self.best_acc = accuracy
         return path
 
-    def resume(self, path: Optional[str] = None) -> ServerState:
-        path = path or self.path
+    # --- periodic / on-failure auto-checkpoints ------------------------
+    def save_auto(self, state: ServerState, extra: Optional[dict] = None):
+        """Rotated auto-checkpoint at the state's current round; the
+        rollback target for the divergence watchdog and the --resume
+        target after a kill.  Accuracy is recorded as -1 (unknown at a
+        round boundary) so keep_best seeding never mistakes an auto
+        save for a best save."""
+        path = self.save(state, accuracy=-1.0,
+                         tag=f"auto-{int(state.round):08d}", extra=extra)
+        self._rotate()
+        return path
+
+    def _auto_paths(self) -> list:
+        names = sorted(n for n in os.listdir(self.dir)
+                       if n.startswith(self._AUTO_PREFIX)
+                       and n.endswith(".npz"))
+        return [os.path.join(self.dir, n) for n in names]
+
+    def _rotate(self):
+        for p in self._auto_paths()[: -self.keep_last]:
+            for victim in (p, p.replace(".npz", ".json")):
+                try:
+                    os.remove(victim)
+                except OSError:
+                    pass
+
+    def latest_auto(self) -> Optional[str]:
+        autos = self._auto_paths()
+        return autos[-1] if autos else None
+
+    def latest(self) -> Optional[str]:
+        """Newest checkpoint by saved round — auto saves and the best
+        save compete, so ``--resume`` (no path) continues from wherever
+        the run actually got to."""
+        candidates = self._auto_paths()
+        if os.path.exists(self.path):
+            candidates.append(self.path)
+        best, best_round = None, -1
+        for p in candidates:
+            try:
+                r = int(np.load(p)["round"])
+            except Exception:
+                continue
+            if r >= best_round:
+                best, best_round = p, r
+        return best
+
+    def load_best_acc(self) -> float:
+        """Accuracy recorded in the best checkpoint's sidecar (or the
+        .npz), for keep_best seeding after an auto-checkpoint resume."""
+        if not os.path.exists(self.path):
+            return -1.0
+        try:
+            return float(np.load(self.path)["accuracy"])
+        except Exception:
+            return -1.0
+
+    def resume(self, path: Optional[str] = None, with_extra: bool = False):
+        path = path or self.latest() or self.path
         z = np.load(path)
-        return ServerState(weights=jnp.asarray(z["weights"]),
-                           velocity=jnp.asarray(z["velocity"]),
-                           round=jnp.asarray(z["round"]))
+        state = ServerState(weights=jnp.asarray(z["weights"]),
+                            velocity=jnp.asarray(z["velocity"]),
+                            round=jnp.asarray(z["round"]))
+        if not with_extra:
+            return state
+        extra = {k[len("extra_"):]: z[k] for k in z.files
+                 if k.startswith("extra_")}
+        return state, extra
 
     def exists(self) -> bool:
         return os.path.exists(self.path)

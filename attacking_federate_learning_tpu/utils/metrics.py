@@ -53,6 +53,10 @@ EVENT_KINDS = {
     "attack": {"round", "attack"},
     # end-of-run selection histogram (the GRID_RESULTS top-1 analysis)
     "selection_hist": {"defense", "counts"},
+    # fault-injection / recovery accounting (core/faults.py + the
+    # engine's divergence watchdog): per-round injected/quarantined
+    # counts, and rollback records (rolled_back, restored_round)
+    "fault": {"round"},
 }
 
 

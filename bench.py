@@ -168,13 +168,18 @@ def phase(name, seconds):
 
 
 def relay_alive():
-    """Relay port probe; every positive result stamps ``window_s`` in the
-    emitted JSON — how long after bench start the relay was last seen
-    alive — so a partial capture's timeline is interpretable."""
+    """Relay liveness probe with bounded retry-with-backoff (3 probes,
+    0.5 s/1 s backoff — utils/backend.py:relay_ports_listening_retry):
+    a slow-but-alive relay (accept queue full, mid-restart) must not be
+    misclassified as dead and silently bench the run on CPU, while a
+    truly dead relay still resolves in a few bounded seconds.  Every
+    positive result stamps ``window_s`` in the emitted JSON — how long
+    after bench start the relay was last seen alive — so a partial
+    capture's timeline is interpretable."""
     from attacking_federate_learning_tpu.utils.backend import (
-        relay_ports_listening
+        relay_ports_listening_retry
     )
-    alive = relay_ports_listening(timeout=1.0)
+    alive = relay_ports_listening_retry(timeout=1.0)
     if alive:
         RESULT["window_s"] = round(time.perf_counter() - _T0, 1)
     return alive

@@ -262,7 +262,13 @@ def test_schema_roundtrip_every_engine_kind(tmp_path):
                      data_placement="host_stream", telemetry=True,
                      synth_train=512)
     _, ev2 = _run(cfg2, tmp_path, "roundtrip2")
-    for rec in ev1 + ev2:
+    # Run 3: fault injection (the 'fault' kind, core/faults.py).
+    from attacking_federate_learning_tpu.config import FaultConfig
+
+    cfg3 = _tele_cfg(tmp_path, defense="Median", epochs=3, test_step=3,
+                     faults=FaultConfig(dropout=0.3))
+    _, ev3 = _run(cfg3, tmp_path, "roundtrip3")
+    for rec in ev1 + ev2 + ev3:
         validate_event(rec)
         assert rec["v"] == 1
         seen.add(rec["kind"])

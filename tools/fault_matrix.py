@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Fault x defense smoke sweep: inject, quarantine, validate, in CI time.
+
+Runs a short (default 5-round) SYNTH_MNIST experiment for every
+mask-aware defense under a dropout+straggler+corrupt fault schedule,
+then closes the loop three ways:
+
+1. every run completes without raising (graceful degradation),
+2. the emitted JSONL validates against the event schema
+   (tools/check_events.py — the same validator CI wires for telemetry),
+3. the per-round 'fault' event counts match a HOST-SIDE REPLAY of the
+   deterministic injection schedule (core/faults.py:fault_masks is pure
+   in (key, round), so the expected counts are recomputable without
+   touching the engine) — an emitted count that drifts from the
+   schedule fails the sweep.
+
+Usage:
+    python tools/fault_matrix.py                        # full smoke
+    python tools/fault_matrix.py --epochs 5 --defenses Krum,Median
+
+Exit status 0 when every cell passes, 1 otherwise.  CI-wired via
+tests/test_faults.py next to the check_events hook.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from attacking_federate_learning_tpu.core.faults import (  # noqa: E402
+    MASK_AWARE_DEFENSES
+)
+
+
+def expected_schedule(cfg, m, m_mal, epochs):
+    """Host replay of the deterministic injection schedule: per-round
+    (dropout, straggler, corrupt, quarantined) counts recomputed from
+    the same PRNG derivation the fused round program uses."""
+    import numpy as np
+
+    from attacking_federate_learning_tpu.core.faults import (
+        fault_key, fault_masks
+    )
+
+    key = fault_key(cfg)
+    rows = []
+    for t in range(epochs):
+        drop, stale, corrupt = (np.asarray(x) for x in
+                                fault_masks(key, t, m, m_mal, cfg.faults))
+        quarantined = int(drop.sum())
+        if cfg.faults.corrupt_mode in ("nan", "inf"):
+            quarantined += int(corrupt.sum())
+        rows.append({"injected_dropout": int(drop.sum()),
+                     "injected_straggler": int(stale.sum()),
+                     "injected_corrupt": int(corrupt.sum()),
+                     "quarantined": quarantined})
+    return rows
+
+
+def run_cell(defense, faults_kw, epochs, users, log_dir):
+    """One fault x defense cell; returns (jsonl_path, cfg, error-or-None)."""
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.attacks import DriftAttack
+    from attacking_federate_learning_tpu.config import (
+        ExperimentConfig, FaultConfig
+    )
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+    from attacking_federate_learning_tpu.utils.metrics import RunLogger
+
+    cfg = ExperimentConfig(
+        dataset=C.SYNTH_MNIST, users_count=users,
+        mal_prop=0.2 if users >= 15 else 0.1,
+        batch_size=16, epochs=epochs, test_step=epochs,
+        defense=defense, synth_train=256, synth_test=64,
+        faults=FaultConfig(**faults_kw), log_dir=log_dir)
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=256, synth_test=64)
+    exp = FederatedExperiment(cfg, attacker=DriftAttack(1.0), dataset=ds)
+    name = f"fault_matrix_{defense}"
+    try:
+        with RunLogger(cfg, None, log_dir, jsonl_name=name) as logger:
+            exp.run(logger)
+    except Exception as e:                        # noqa: BLE001
+        return os.path.join(log_dir, name + ".jsonl"), cfg, f"raised: {e}"
+    return os.path.join(log_dir, name + ".jsonl"), cfg, None
+
+
+def check_cell(path, cfg, epochs):
+    """Schema-validate the run log and diff its 'fault' events against
+    the host replay; returns a list of error strings (empty = pass)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_events", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "check_events.py"))
+    ce = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ce)
+
+    errors = []
+    counts, _, bad_lines = ce.check_file(path)
+    errors += [f"line {ln}: {msg}" for ln, msg in bad_lines]
+    faults = []
+    from attacking_federate_learning_tpu.utils.metrics import iter_events
+    for e in iter_events(path):
+        if e["kind"] == "fault" and not e.get("rolled_back"):
+            faults.append(e)
+    if len(faults) != epochs:
+        errors.append(f"expected {epochs} fault events, got {len(faults)}")
+        return errors
+    exp_cfg = cfg
+    want = expected_schedule(exp_cfg, exp_cfg.users_count,
+                             exp_cfg.corrupted_count, epochs)
+    for t, (got, exp) in enumerate(zip(sorted(faults,
+                                              key=lambda e: e["round"]),
+                                       want)):
+        for k, v in exp.items():
+            if int(got.get(k, -1)) != v:
+                errors.append(
+                    f"round {t}: {k} emitted {got.get(k)} != scheduled {v}")
+    return errors
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="5-round fault x defense smoke sweep with schedule "
+                    "validation (core/faults.py).")
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--users", type=int, default=15)
+    p.add_argument("--defenses", default=",".join(MASK_AWARE_DEFENSES),
+                   help="comma-separated subset of the mask-aware "
+                        "defenses")
+    p.add_argument("--dropout", type=float, default=0.2)
+    p.add_argument("--straggler", type=float, default=0.1)
+    p.add_argument("--corrupt", type=float, default=0.05)
+    p.add_argument("--log-dir", default=None,
+                   help="where run JSONLs land (default: a temp dir)")
+    args = p.parse_args(argv)
+
+    log_dir = args.log_dir or tempfile.mkdtemp(prefix="fault_matrix_")
+    faults_kw = dict(dropout=args.dropout, straggler=args.straggler,
+                     corrupt=args.corrupt)
+    failed = False
+    for defense in args.defenses.split(","):
+        defense = defense.strip()
+        path, cfg, err = run_cell(defense, faults_kw, args.epochs,
+                                  args.users, log_dir)
+        errors = ([err] if err else []) + (
+            [] if err else check_cell(path, cfg, args.epochs))
+        if errors:
+            failed = True
+            print(f"FAIL {defense}: {len(errors)} problem(s)")
+            for e in errors[:10]:
+                print(f"  {e}")
+        else:
+            print(f"ok   {defense}: {args.epochs} rounds, fault events "
+                  f"match the injected schedule  ({path})")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
