@@ -277,6 +277,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "subcommand)")
     p.add_argument("--trace-dir", type=str, default=None,
                    help="capture a jax.profiler XLA trace into this dir")
+    p.add_argument("--cost-report", action="store_true",
+                   help="before training, lower+compile every jitted "
+                        "entry point once and record its static HLO "
+                        "cost facts (FLOPs, bytes accessed, memory "
+                        "sizes) and compile/cache attribution as "
+                        "'compile'/'cost' events (utils/costs.py; read "
+                        "with the 'report' subcommand)")
+    p.add_argument("--heartbeat", default=0.0, type=float, metavar="SECS",
+                   help="append a 'heartbeat' event every SECS seconds "
+                        "(round, rounds/s EMA, rss, last-event age) so "
+                        "a stalled run is distinguishable from a long "
+                        "compile by tailing the events file; 0 = off")
     return p
 
 
@@ -406,7 +418,8 @@ def main(argv=None):
 
     # Context-managed: the JSONL handle is closed and the accuracy CSV
     # written even when the run raises (utils/metrics.py:RunLogger).
-    with RunLogger(cfg, cfg.output, cfg.log_dir) as logger:
+    with RunLogger(cfg, cfg.output, cfg.log_dir,
+                   heartbeat_every=args.heartbeat) as logger:
         logger.dump_config()
 
         dataset = load_dataset(cfg.dataset, cfg.data_dir, cfg.seed,
@@ -459,6 +472,19 @@ def main(argv=None):
                 # incl. the host-streaming keep-on-host contract).
                 exp.state = exp.shardings.place_state(exp.state)
             logger.print(f"Resumed from round {int(exp.state.round)}")
+        if args.cost_report:
+            # Static compile-and-cost facts, BEFORE training: the same
+            # compiles the run pays anyway (persistent-cache-warmed),
+            # analyzed once and recorded as 'compile'/'cost' events.
+            ledger = exp.cost_report(logger)
+            for rec in ledger.records:
+                logger.print(
+                    f"[cost] {rec.name:16s} flops={rec.flops:.3e}  "
+                    f"bytes={rec.bytes_accessed:.3e}  "
+                    f"peak={rec.peak_bytes / 1e6:.1f} MB  "
+                    f"compile={rec.compile_s:.2f}s ({rec.cache})")
+            for name, msg in ledger.errors:
+                logger.print(f"[cost] {name}: analysis failed: {msg}")
         timer = PhaseTimer() if args.profile else None
         with xla_trace(args.trace_dir):
             result = exp.run(logger, checkpointer=checkpointer, timer=timer)

@@ -784,6 +784,118 @@ class FederatedExperiment:
         self._finish_telemetry = finish_telemetry
 
     # ------------------------------------------------------------------
+    def cost_report(self, logger=None, span: Optional[int] = None):
+        """Static compile-and-cost facts for every jitted entry point
+        this engine built (utils/costs.py): each is lowered and
+        compiled ONCE — AOT, no execution — and its deterministic HLO
+        facts (cost_analysis FLOPs / bytes-accessed, memory_analysis
+        buffer sizes) plus compile wall time and persistent-cache
+        attribution are collected into a CompileLedger.  With a
+        ``logger``, one 'compile' + one 'cost' event (schema v2) lands
+        per entry point; tools/perf_gate.py diffs the same facts
+        against PERF_BASELINE.json.
+
+        The report is an observer: it never touches the round
+        functions themselves (their HLO is pinned byte-identical with
+        the report on or off — tests/test_costs.py), and the compiles
+        it pays are exactly the ones the run would pay anyway, warmed
+        through the persistent cache.
+
+        ``span``: the span length to analyze for the static-length span
+        programs (default: the eval interval, the length the run
+        compiles first)."""
+        import jax
+
+        from attacking_federate_learning_tpu.utils.costs import (
+            CompileLedger
+        )
+
+        cfg = self.cfg
+        ledger = CompileLedger()
+        t0 = jnp.asarray(0, jnp.int32)
+        span_len = int(span or max(1, min(cfg.test_step, cfg.epochs)))
+        d = self.flat.dim
+        if self._streaming:
+            # Streamed rounds take the round batch as an argument;
+            # abstract shapes suffice for lowering.
+            kB = cfg.batch_size * cfg.local_steps
+            batches = (jax.ShapeDtypeStruct(
+                           (self.m, kB) + self.dataset.train_x.shape[1:],
+                           jnp.float32),
+                       jax.ShapeDtypeStruct((self.m, kB), jnp.int32))
+        else:
+            batches = None
+
+        entries = []
+        if not self._staged:
+            if self.faults is None:
+                entries.append(("fused_round", lambda: self._fused_round
+                                .lower(self.state, t0, batches)))
+                if not self._streaming:
+                    # Span length is a traced operand: one compilation
+                    # covers every span, so one analysis does too.
+                    entries.append(
+                        ("fused_span", lambda: self._fused_span.lower(
+                            self.state, t0,
+                            jnp.asarray(span_len, jnp.int32))))
+                    if cfg.telemetry:
+                        entries.append(
+                            ("tele_span", lambda: self._tele_span.lower(
+                                self.state, t0, span_len)))
+            else:
+                entries.append(("fused_round", lambda: self._fused_round
+                                .lower(self.state, t0, self._fault_state,
+                                       batches)))
+                entries.append(
+                    ("fault_span", lambda: self._fault_span.lower(
+                        self.state, t0, span_len, self._fault_state)))
+        else:
+            entries.append(("compute_grads", lambda: self._compute_grads
+                            .lower(self.state, t0, batches)))
+            grads_sds = jax.ShapeDtypeStruct((self.m, d), self._grad_dtype)
+            if hasattr(self._aggregate, "lower"):
+                # The staged CPU Krum/Bulyan aggregation runs EAGERLY
+                # (host BLAS) — nothing compiled to analyze there.
+                entries.append(("aggregate", lambda: self._aggregate.lower(
+                    self.state, grads_sds, t0)))
+            if cfg.telemetry and hasattr(self._aggregate_tele, "lower"):
+                entries.append(
+                    ("aggregate_tele", lambda: self._aggregate_tele.lower(
+                        self.state, grads_sds, t0)))
+
+        # The wired defense kernel in isolation: the per-cell
+        # defense-cost row of the attack x defense grid (ALIE vs Bulyan
+        # cells differ by orders of magnitude in O(n^2 d) kernel cost —
+        # this is where that becomes a recorded number).
+        kw = {}
+        if getattr(self.defense_fn, "needs_round", False):
+            kw["round"] = t0
+        if self._needs_server_grad:
+            kw["server_grad"] = jax.ShapeDtypeStruct((d,), jnp.float32)
+        grads_sds = jax.ShapeDtypeStruct((self.m, d), self._grad_dtype)
+        defense_fn = self.defense_fn
+
+        def defense_lowered():
+            jitted = jax.jit(lambda G, **kws: defense_fn(
+                G, self.m, self.m_mal, **kws))
+            return jitted.lower(grads_sds, **kw)
+
+        entries.append((f"defense_{cfg.defense}", defense_lowered))
+        entries.append(("eval", lambda: self.evaluate.lower(
+            jax.ShapeDtypeStruct((d,), jnp.float32))))
+
+        for name, thunk in entries:
+            try:
+                ledger.analyze(name, thunk())
+            except Exception as e:        # noqa: BLE001 — one entry
+                # failing to lower must not lose the rest of the table
+                ledger.errors.append((name, f"{type(e).__name__}: {e}"))
+        if logger is not None:
+            ledger.emit(logger)
+        self.cost_ledger = ledger
+        return ledger
+
+    # ------------------------------------------------------------------
     @staticmethod
     def _donate_kw():
         """Server-state donation policy: donate on accelerators (HBM

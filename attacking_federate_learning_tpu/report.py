@@ -109,6 +109,54 @@ def fault_recovery(events):
             "quarantined": quarantined, "rollbacks": rollbacks}
 
 
+def compile_cost(events):
+    """The compile & cost table ('compile'/'cost' events, schema v2 —
+    utils/costs.py): per entry point, static FLOPs / bytes-accessed /
+    peak-memory facts joined with compile wall time and persistent-
+    cache attribution, plus a hit/miss/compile-seconds rollup.  Returns
+    None when the run recorded neither kind (cost report off)."""
+    compiles = {e["name"]: e for e in events if e.get("kind") == "compile"}
+    costs = {e["name"]: e for e in events if e.get("kind") == "cost"}
+    if not compiles and not costs:
+        return None
+    names = list(costs)
+    names += [n for n in compiles if n not in costs]
+    rows = []
+    for name in names:
+        c, k = costs.get(name, {}), compiles.get(name, {})
+        rows.append({
+            "name": name,
+            "flops": c.get("flops"),
+            "bytes_accessed": c.get("bytes_accessed"),
+            "peak_bytes": c.get("peak_bytes"),
+            "compile_s": k.get("compile_s"),
+            "cache": k.get("cache"),
+        })
+    cache_tags = [k.get("cache") for k in compiles.values()]
+    return {
+        "entries": rows,
+        "compile_total_s": round(sum(k.get("compile_s", 0.0)
+                                     for k in compiles.values()), 3),
+        "cache_hits": sum(1 for t in cache_tags if t == "hit"),
+        "cache_misses": sum(1 for t in cache_tags if t == "miss"),
+    }
+
+
+def heartbeat_summary(events):
+    """Liveness rollup from 'heartbeat' events: count, max last-event
+    age (the stall witness) and the final rounds/s EMA."""
+    beats = [e for e in events if e.get("kind") == "heartbeat"]
+    if not beats:
+        return None
+    out = {"beats": len(beats),
+           "max_event_age_s": max(e["last_event_age_s"] for e in beats),
+           "rss_mb_last": beats[-1]["rss_mb"]}
+    with_rps = [e for e in beats if "rounds_per_s" in e]
+    if with_rps:
+        out["rounds_per_s_last"] = with_rps[-1]["rounds_per_s"]
+    return out
+
+
 def summarize_run(events):
     """One run's report payload from its event list."""
     kinds = Counter(e["kind"] for e in events)
@@ -147,6 +195,12 @@ def summarize_run(events):
                                       "top1_share", "top1_client",
                                       "malicious_picks")
             if k in hists[-1]}
+    cc = compile_cost(events)
+    if cc:
+        out["compile_cost"] = cc
+    hb = heartbeat_summary(events)
+    if hb:
+        out["heartbeat"] = hb
     profiles = [e for e in events if e["kind"] == "profile"]
     if profiles:
         out["phases"] = profiles[-1]["phases"]
@@ -193,6 +247,31 @@ def _print_run(path, s, out):
         for rb in flt["rollbacks"]:
             out(f"    rollback at round {rb['round']} -> restored round "
                 f"{rb['restored_round']} (total {rb['rollbacks_total']})")
+    cc = s.get("compile_cost")
+    if cc:
+        out(f"  compile & cost ({cc['compile_total_s']:.2f} s total "
+            f"compile; cache {cc['cache_hits']} hit / "
+            f"{cc['cache_misses']} miss):")
+        for r in cc["entries"]:
+            flops = (f"{r['flops']:.3e}" if r.get("flops") is not None
+                     else "-")
+            byts = (f"{r['bytes_accessed']:.3e}"
+                    if r.get("bytes_accessed") is not None else "-")
+            peak = (f"{r['peak_bytes'] / 1e6:8.1f} MB"
+                    if r.get("peak_bytes") is not None else "        -")
+            comp = (f"{r['compile_s']:6.2f} s"
+                    if r.get("compile_s") is not None else "     -")
+            out(f"    {r['name']:16s} flops {flops:>10s}   "
+                f"bytes {byts:>10s}   peak {peak}   "
+                f"compile {comp} ({r.get('cache', '-')})")
+    hb = s.get("heartbeat")
+    if hb:
+        line = (f"  heartbeat: {hb['beats']} beats, max event age "
+                f"{hb['max_event_age_s']:.1f} s, rss "
+                f"{hb['rss_mb_last']:.0f} MB")
+        if "rounds_per_s_last" in hb:
+            line += f", {hb['rounds_per_s_last']:.2f} rounds/s"
+        out(line)
     if "phases" in s:
         out("  phase timing:")
         for name, row in s["phases"].items():

@@ -144,6 +144,16 @@ def enable_compile_cache(path=None):
     jax.config.update("jax_compilation_cache_dir", path)
     if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # Compile observability (utils/costs.py): every entry point that
+    # enables the cache also counts its hits/misses, so bench.py and
+    # the cost report can attribute "fast because warm" vs "fast,
+    # period" — installed here (before the first compile) rather than
+    # per caller.
+    from attacking_federate_learning_tpu.utils.costs import (
+        install_cache_counters
+    )
+
+    install_cache_counters()
 
 
 def ensure_live_backend(probe_timeout=240):

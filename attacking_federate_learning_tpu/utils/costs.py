@@ -1,0 +1,254 @@
+"""Static compile-and-cost accounting for jitted entry points.
+
+Wall-clock benchmarks on this box are scarce (TPU relay windows) and
+noisy (one shared core), so the performance layer is anchored on facts
+that are DETERMINISTIC for a given (HLO, XLA version, platform) triple
+and need no timer:
+
+- ``cost_analysis()``: XLA's static FLOP and bytes-accessed count for
+  the optimized executable — the O(n^2 d) Krum/Bulyan distance engine
+  shows up here as real numbers per compiled round program;
+- ``memory_analysis()``: argument/output/temp/alias buffer sizes, from
+  which a peak-usage proxy is derived (jaxlib 0.4's
+  ``CompiledMemoryStats`` has no explicit peak field on CPU).
+
+:func:`analyze_lowered` runs ``.compile()`` on a ``jax.stages.Lowered``
+ONCE, times the compile, attributes it to the persistent compile cache
+(hit / miss / uncached) and returns a :class:`CostRecord`.  The records
+feed the versioned ``compile`` / ``cost`` event kinds
+(utils/metrics.py schema v2), the ``report`` subcommand's
+"compile & cost" table, ``bench.py`` metadata, and the deterministic
+perf-regression gate (tools/perf_gate.py) — which can therefore run on
+CPU, without a TPU or a stopwatch.
+
+Cache attribution is two-source, because neither source alone is
+conclusive on this jax (0.4.37):
+
+- a process-wide hit/miss counter fed by jax's own monitoring events
+  (``/jax/compilation_cache/cache_hits`` / ``cache_misses``), installed
+  lazily by :func:`install_cache_counters`;
+- a before/after scan of the fingerprinted cache directory
+  (utils/backend.py:host_cache_fingerprint keys the dir): a compile
+  that ADDS an entry is a certain miss even if monitoring is silent.
+
+A compile that neither bumped a counter nor wrote an entry is reported
+``uncached`` (persistent cache disabled, or the compile finished under
+``jax_persistent_cache_min_compile_time_secs``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Optional
+
+
+# Cost-analysis keys we surface (cost_analysis() returns many more
+# per-operand utilization entries; these are the stable, comparable ones).
+_COST_KEYS = {"flops": "flops", "bytes accessed": "bytes_accessed"}
+
+
+@dataclasses.dataclass
+class CostRecord:
+    """Static facts for one compiled entry point.
+
+    ``flops`` / ``bytes_accessed`` are exact for a given (HLO, XLA,
+    platform); ``peak_bytes`` is the argument+output+temp−alias proxy
+    (an upper bound on resident executable memory, compared with a
+    tolerance by the perf gate).  ``cache`` is 'hit' | 'miss' |
+    'uncached'; ``compile_s`` is the observed ``.compile()`` wall time
+    (diagnostic only — never gated on)."""
+
+    name: str
+    platform: str
+    flops: float = -1.0
+    bytes_accessed: float = -1.0
+    argument_bytes: int = 0
+    output_bytes: int = 0
+    temp_bytes: int = 0
+    alias_bytes: int = 0
+    generated_code_bytes: int = 0
+    compile_s: float = 0.0
+    cache: str = "uncached"
+
+    @property
+    def peak_bytes(self) -> int:
+        return (self.argument_bytes + self.output_bytes + self.temp_bytes
+                - self.alias_bytes)
+
+    def cost_event(self) -> dict:
+        """Payload for a 'cost' event (metrics.py schema v2)."""
+        return dict(kind="cost", name=self.name, flops=self.flops,
+                    bytes_accessed=self.bytes_accessed,
+                    peak_bytes=self.peak_bytes,
+                    argument_bytes=self.argument_bytes,
+                    output_bytes=self.output_bytes,
+                    temp_bytes=self.temp_bytes,
+                    generated_code_bytes=self.generated_code_bytes)
+
+    def compile_event(self) -> dict:
+        """Payload for a 'compile' event (metrics.py schema v2)."""
+        return dict(kind="compile", name=self.name,
+                    compile_s=round(self.compile_s, 4), cache=self.cache,
+                    platform=self.platform)
+
+    def gate_facts(self) -> dict:
+        """The facts tools/perf_gate.py diffs: exact ones first, then
+        the tolerance-compared memory sizes."""
+        return {"flops": self.flops, "bytes_accessed": self.bytes_accessed,
+                "argument_bytes": self.argument_bytes,
+                "output_bytes": self.output_bytes,
+                "temp_bytes": self.temp_bytes,
+                "peak_bytes": self.peak_bytes}
+
+
+# --- persistent-cache hit/miss accounting ------------------------------
+
+class _CacheCounters:
+    hits = 0
+    misses = 0
+    installed = False
+
+
+def install_cache_counters() -> None:
+    """Count persistent-compile-cache hits/misses process-wide via jax's
+    monitoring events.  Idempotent; safe on any jax that lacks the
+    events (the listener just never fires)."""
+    if _CacheCounters.installed:
+        return
+    _CacheCounters.installed = True
+    try:
+        from jax._src import monitoring
+    except Exception:      # private module — may move between versions
+        return
+
+    def listen(event, **kw):
+        if event == "/jax/compilation_cache/cache_hits":
+            _CacheCounters.hits += 1
+        elif event == "/jax/compilation_cache/cache_misses":
+            _CacheCounters.misses += 1
+
+    monitoring.register_event_listener(listen)
+
+
+def cache_counts() -> dict:
+    """Process-wide persistent-cache hit/miss totals (zeros until
+    install_cache_counters ran AND a cached compile happened)."""
+    return {"hits": _CacheCounters.hits, "misses": _CacheCounters.misses}
+
+
+def compilation_cache_dir() -> Optional[str]:
+    """The active persistent-cache directory, or None when disabled."""
+    import jax
+
+    try:
+        path = jax.config.jax_compilation_cache_dir
+    except AttributeError:
+        path = None
+    return path or None
+
+
+def _cache_entries(path: Optional[str]) -> Optional[frozenset]:
+    if not path or not os.path.isdir(path):
+        return None
+    try:
+        return frozenset(f for f in os.listdir(path)
+                         if not f.endswith("-atime"))
+    except OSError:
+        return None
+
+
+# --- per-entry-point analysis ------------------------------------------
+
+def _first(d):
+    """cost_analysis() returns a list of per-program dicts on this
+    jaxlib (one element for single-device programs) but a bare dict on
+    newer ones — normalize."""
+    if isinstance(d, (list, tuple)):
+        return d[0] if d else {}
+    return d or {}
+
+
+def compiled_cost_facts(compiled) -> dict:
+    """Extract the deterministic facts from a ``jax.stages.Compiled``.
+    Missing analyses (some backends return None) yield -1 sentinels so
+    a reader can tell "not measured" from a real zero."""
+    out = {"flops": -1.0, "bytes_accessed": -1.0, "argument_bytes": 0,
+           "output_bytes": 0, "temp_bytes": 0, "alias_bytes": 0,
+           "generated_code_bytes": 0}
+    try:
+        ca = _first(compiled.cost_analysis())
+    except Exception:
+        ca = {}
+    for key, field in _COST_KEYS.items():
+        if key in ca:
+            out[field] = float(ca[key])
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        ma = None
+    if ma is not None:
+        out["argument_bytes"] = int(ma.argument_size_in_bytes)
+        out["output_bytes"] = int(ma.output_size_in_bytes)
+        out["temp_bytes"] = int(ma.temp_size_in_bytes)
+        out["alias_bytes"] = int(ma.alias_size_in_bytes)
+        out["generated_code_bytes"] = int(ma.generated_code_size_in_bytes)
+    return out
+
+
+def analyze_lowered(name: str, lowered) -> CostRecord:
+    """Compile a ``jax.stages.Lowered`` once; return its CostRecord.
+
+    Cache attribution: monitoring counters are snapshotted around the
+    compile (exact when they fire), with the fingerprint-dir scan as
+    the fallback witness — an entry added during the compile is a miss
+    even when monitoring is unavailable."""
+    import jax
+
+    install_cache_counters()
+    platform = jax.devices()[0].platform
+    cdir = compilation_cache_dir()
+    before = _cache_entries(cdir)
+    hits0, misses0 = _CacheCounters.hits, _CacheCounters.misses
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    dt = time.perf_counter() - t0
+    after = _cache_entries(cdir)
+    if _CacheCounters.hits > hits0:
+        cache = "hit"
+    elif _CacheCounters.misses > misses0:
+        cache = "miss"
+    elif before is not None and after is not None and after - before:
+        cache = "miss"
+    else:
+        cache = "uncached"
+    rec = CostRecord(name=name, platform=platform, compile_s=dt,
+                     cache=cache, **compiled_cost_facts(compiled))
+    return rec
+
+
+class CompileLedger:
+    """Per-run collection of CostRecords (core/engine.py:cost_report
+    fills one; report.py renders it as the compile & cost table)."""
+
+    def __init__(self):
+        self.records: list = []
+        self.errors: list = []   # (name, message) for entries that
+        # failed to lower/compile — kept out of records so the gate
+        # never diffs a partial fact set silently
+
+    def analyze(self, name: str, lowered) -> CostRecord:
+        rec = analyze_lowered(name, lowered)
+        self.records.append(rec)
+        return rec
+
+    def emit(self, logger) -> None:
+        """Write one 'compile' + one 'cost' event per record."""
+        for rec in self.records:
+            logger.record(**rec.compile_event())
+            logger.record(**rec.cost_event())
+
+    def summary(self) -> dict:
+        """{name: gate_facts} — the shape PERF_BASELINE.json stores."""
+        return {rec.name: rec.gate_facts() for rec in self.records}

@@ -11,12 +11,21 @@ stream stall stats, and the telemetry pipeline's per-round defense/attack
 forensics (core/engine.py) — validated at the emitter so malformed events
 fail the producing run, not a downstream reader.
 
-Event contract (schema v1): every event is one JSON object per line with a
+Event contract (schema v2): every event is one JSON object per line with a
 ``kind`` from :data:`EVENT_KINDS`, that kind's required fields, a schema
 version ``v`` and a relative timestamp ``t``.  Extra fields are always
 allowed (they're how diagnostics grow without a version bump); missing
 required fields or unknown kinds are errors.  ``tools/check_events.py`` is
 the standalone validator; ``report.py`` is the reader.
+
+Version history: v1 introduced the structured kinds (round/eval/asr/
+profile/stream/defense/attack/selection_hist, later fault); v2 adds the
+compile-and-cost observatory kinds — ``compile`` (per-entry-point
+compile wall time + persistent-cache attribution), ``cost`` (static HLO
+FLOPs / bytes-accessed / memory facts, utils/costs.py) and
+``heartbeat`` (the RunLogger liveness thread).  Readers accept both
+versions; v1 events simply never carry the v2 kinds, and a v2 kind
+stamped v1 is an emitter bug, rejected.
 """
 
 from __future__ import annotations
@@ -24,13 +33,15 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 import time
 from typing import Optional
 
 import numpy as np
 
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+SUPPORTED_VERSIONS = (1, 2)
 
 # kind -> required fields.  Producers: core/engine.py (round, eval, asr,
 # profile, stream, defense, attack, selection_hist via RunLogger).
@@ -57,29 +68,54 @@ EVENT_KINDS = {
     # engine's divergence watchdog): per-round injected/quarantined
     # counts, and rollback records (rolled_back, restored_round)
     "fault": {"round"},
+    # --- v2: the compile-and-cost observatory (utils/costs.py) ---------
+    # per-entry-point compile record: wall time + persistent-cache
+    # attribution ('hit'/'miss'/'uncached') + backend platform
+    "compile": {"name", "compile_s", "cache"},
+    # static HLO facts for the same entry point: exact FLOPs and
+    # bytes-accessed (cost_analysis), memory sizes (memory_analysis)
+    "cost": {"name", "flops", "bytes_accessed", "peak_bytes"},
+    # RunLogger liveness thread: emitted every N seconds so a stalled
+    # capture is distinguishable from a long compile by tailing the
+    # events file (round / rounds-per-sec EMA ride along when known)
+    "heartbeat": {"rss_mb", "last_event_age_s"},
 }
+
+# Kinds introduced by schema v2; an event carrying one of these but
+# stamped v1 is an emitter bug (a v1 writer cannot know these kinds).
+V2_KINDS = {"compile", "cost", "heartbeat"}
 
 
 def validate_event(rec) -> dict:
     """Validate one event against the schema; returns it or raises
-    ValueError.  Unknown kinds and missing required fields are errors;
-    extra fields are not (diagnostics grow without a version bump)."""
+    ValueError.  Unknown kinds, unknown schema versions and missing
+    required fields are errors; extra fields are not (diagnostics grow
+    without a version bump)."""
     if not isinstance(rec, dict):
         raise ValueError(
             f"event must be a JSON object, got {type(rec).__name__}")
+    v = rec.get("v", SCHEMA_VERSION)
+    if v not in SUPPORTED_VERSIONS:
+        # Version first: an event from a NEWER writer may carry kinds
+        # this reader has never heard of — "unknown kind" would
+        # misdiagnose that as emitter corruption.
+        raise ValueError(
+            f"unsupported event schema version {v!r} (this reader "
+            f"speaks v{min(SUPPORTED_VERSIONS)}..v{max(SUPPORTED_VERSIONS)}"
+            f"; a newer writer's logs need a newer reader)")
     kind = rec.get("kind")
     if kind not in EVENT_KINDS:
         raise ValueError(
             f"unknown event kind {kind!r} (schema v{SCHEMA_VERSION}; "
             f"known: {sorted(EVENT_KINDS)})")
+    if kind in V2_KINDS and v < 2:
+        raise ValueError(
+            f"{kind!r} events need schema v2, but this one is stamped "
+            f"v{v} (emitter bug: a v1 writer cannot produce this kind)")
     missing = EVENT_KINDS[kind] - rec.keys()
     if missing:
         raise ValueError(
             f"{kind!r} event missing required fields {sorted(missing)}")
-    v = rec.get("v", SCHEMA_VERSION)
-    if v != SCHEMA_VERSION:
-        raise ValueError(f"unsupported event schema version {v!r} "
-                         f"(this reader speaks v{SCHEMA_VERSION})")
     if "round" in EVENT_KINDS[kind] and not isinstance(
             rec["round"], (int, float)):
         raise ValueError(
@@ -109,6 +145,19 @@ def iter_events(path, validate: bool = True):
             yield rec
 
 
+def _rss_mb() -> float:
+    """Resident set size in MB via /proc (no psutil on this image);
+    0.0 where /proc is absent — the heartbeat still carries the ages."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0
+    except (OSError, ValueError, IndexError):
+        pass
+    return 0.0
+
+
 class RunLogger:
     """Tee + CSV + structured JSONL sink; a context manager.
 
@@ -117,10 +166,20 @@ class RunLogger:
     (crash-safe ``close``).  ``finish()`` (CSV + JSONL close) is
     idempotent and leaves the tee handle open so callers can still
     ``print`` a trailing summary line; ``close()`` / ``__exit__`` shut
-    everything."""
+    everything.
+
+    ``heartbeat_every > 0`` starts a daemon thread that appends a small
+    'heartbeat' event (schema v2) every N seconds: last-seen round, a
+    rounds/s EMA, resident set size, and the age of the last REAL event
+    — so ``tail -f run.jsonl`` distinguishes a stalled TPU capture or a
+    dead relay (age grows unbounded, rss flat) from a long compile or a
+    long fused span (age grows, then one burst of round events).
+    Heartbeats never update the last-event clock — they must not mask
+    the very stall they exist to expose."""
 
     def __init__(self, config, output: Optional[str] = None,
-                 log_dir: str = "logs", jsonl_name: Optional[str] = None):
+                 log_dir: str = "logs", jsonl_name: Optional[str] = None,
+                 heartbeat_every: float = 0.0):
         self.config = config
         self.output = output
         self.log_dir = log_dir
@@ -137,6 +196,18 @@ class RunLogger:
         self.accuracies: list = []
         self.accuracies_epochs: list = []
         self._t0 = time.time()
+        # Heartbeat state (written by record() under the lock, read by
+        # the beat thread).  The JSONL handle is shared with the beat
+        # thread, so every write serializes through _write_lock.
+        self._write_lock = threading.Lock()
+        self._last_event_time = time.time()
+        self._last_round = None
+        self._last_round_time = None
+        self._rps_ema = None
+        self._hb_stop = None
+        self._hb_thread = None
+        if heartbeat_every and heartbeat_every > 0:
+            self._start_heartbeat(float(heartbeat_every))
 
     # --- context manager ------------------------------------------------
     def __enter__(self):
@@ -157,6 +228,56 @@ class RunLogger:
     def dump_config(self):
         self.print(dataclasses.asdict(self.config))
 
+    # --- heartbeat (schema v2) -----------------------------------------
+    def _start_heartbeat(self, every: float):
+        self._hb_stop = threading.Event()
+
+        def beat():
+            while not self._hb_stop.wait(every):
+                if self._finished:
+                    return
+                try:
+                    self.record(**self.heartbeat_fields())
+                except ValueError:
+                    return      # closed mid-beat; the stop flag races
+        self._hb_thread = threading.Thread(
+            target=beat, name="runlogger-heartbeat", daemon=True)
+        self._hb_thread.start()
+
+    def heartbeat_fields(self) -> dict:
+        """One heartbeat payload (also callable without the thread —
+        tests and ad-hoc probes)."""
+        now = time.time()
+        rec = dict(kind="heartbeat",
+                   rss_mb=round(_rss_mb(), 1),
+                   last_event_age_s=round(now - self._last_event_time, 3))
+        if self._last_round is not None:
+            rec["round"] = self._last_round
+        if self._rps_ema is not None:
+            rec["rounds_per_s"] = round(self._rps_ema, 4)
+        return rec
+
+    def _note_progress(self, fields):
+        """Track round progress for the heartbeat: any event carrying a
+        numeric 'round' advances the last-seen round and feeds the
+        rounds/s EMA.  Heartbeats themselves are excluded — they must
+        not reset the stall clock they measure."""
+        if fields.get("kind") == "heartbeat":
+            return
+        now = time.time()
+        self._last_event_time = now
+        rnd = fields.get("round")
+        if not isinstance(rnd, (int, float)):
+            return
+        if (self._last_round is not None and rnd > self._last_round
+                and now > self._last_round_time):
+            rps = (rnd - self._last_round) / (now - self._last_round_time)
+            self._rps_ema = (rps if self._rps_ema is None
+                             else 0.3 * rps + 0.7 * self._rps_ema)
+        if self._last_round is None or rnd >= self._last_round:
+            self._last_round = rnd
+            self._last_round_time = now
+
     # --- structured records --------------------------------------------
     def record(self, **fields):
         fields.setdefault("t", round(time.time() - self._t0, 3))
@@ -165,8 +286,14 @@ class RunLogger:
             # that produced it, not a later reader.
             fields.setdefault("v", SCHEMA_VERSION)
             validate_event(fields)
-        self._jsonl.write(json.dumps(fields, default=float) + "\n")
-        self._jsonl.flush()
+        with self._write_lock:
+            if self._finished:
+                # The beat thread can race finish(); a write to a closed
+                # handle would turn a clean shutdown into a crash.
+                raise ValueError("record() after finish()")
+            self._note_progress(fields)
+            self._jsonl.write(json.dumps(fields, default=float) + "\n")
+            self._jsonl.flush()
 
     def record_eval(self, epoch, test_loss, correct, test_size, asr=None,
                     **extra):
@@ -188,16 +315,25 @@ class RunLogger:
 
     def finish(self):
         """Write the CSV and close the JSONL.  Idempotent; the tee stays
-        open (trailing summary prints still tee) until close()."""
+        open (trailing summary prints still tee) until close().  The
+        heartbeat thread is stopped first — the JSONL handle it writes
+        through is about to close."""
         if self._finished:
             return
-        self._finished = True
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+        with self._write_lock:
+            if self._finished:
+                return
+            self._finished = True
+            self._jsonl.close()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
         if self.accuracies:
             self.print("Max accuracy: {}".format(max(self.accuracies)))
             # CSV with the reference's filename schema (main.py:100).
             np.savetxt(os.path.join(self.log_dir, self.config.csv_name()),
                        np.asarray(self.accuracies), delimiter=",")
-        self._jsonl.close()
 
     def close(self):
         self.finish()

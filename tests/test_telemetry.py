@@ -249,6 +249,10 @@ def test_staged_backdoor_telemetry_has_shadow_loss(tmp_path):
 def test_schema_roundtrip_every_engine_kind(tmp_path):
     """5-round runs covering the full event surface: every record
     validates, and the union of kinds is exactly the schema's."""
+    from attacking_federate_learning_tpu.utils.metrics import (
+        SCHEMA_VERSION
+    )
+
     seen = set()
     # Run 1: Krum + ALIE + telemetry + round stats + profile.
     from attacking_federate_learning_tpu.utils.profiling import PhaseTimer
@@ -268,9 +272,22 @@ def test_schema_roundtrip_every_engine_kind(tmp_path):
     cfg3 = _tele_cfg(tmp_path, defense="Median", epochs=3, test_step=3,
                      faults=FaultConfig(dropout=0.3))
     _, ev3 = _run(cfg3, tmp_path, "roundtrip3")
-    for rec in ev1 + ev2 + ev3:
+    # Run 4: the v2 kinds — cost report (compile/cost) + a heartbeat
+    # (emitted synchronously via heartbeat_fields; the thread variant
+    # is covered in tests/test_costs.py).
+    cfg4 = _tele_cfg(tmp_path, defense="NoDefense", epochs=2, test_step=2)
+    ds4 = load_dataset(cfg4.dataset, seed=0, synth_train=256, synth_test=64)
+    exp4 = FederatedExperiment(cfg4, attacker=DriftAttack(1.0), dataset=ds4)
+    with RunLogger(cfg4, None, str(tmp_path),
+                   jsonl_name="roundtrip4") as logger:
+        exp4.cost_report(logger)
+        logger.record(**logger.heartbeat_fields())
+        path4 = logger.jsonl_path
+    with open(path4) as f:
+        ev4 = [json.loads(line) for line in f]
+    for rec in ev1 + ev2 + ev3 + ev4:
         validate_event(rec)
-        assert rec["v"] == 1
+        assert rec["v"] == SCHEMA_VERSION
         seen.add(rec["kind"])
     assert seen == set(EVENT_KINDS)
 

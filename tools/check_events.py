@@ -7,6 +7,12 @@ validate_event) so a malformed emitter is caught by CI, not by a reader
 weeks later.  No device work (validation is pure Python over parsed
 JSON), so it runs in tier-1 time budget on any backend state.
 
+Speaks every supported schema version (v1 plus v2's compile/cost/
+heartbeat kinds).  An event stamped with a version this reader does not
+know is reported as "produced by a newer writer" — a clear per-line
+error, never a KeyError — and a v2-only kind stamped v1 is flagged as
+an emitter bug (utils/metrics.py:validate_event owns both rules).
+
 Usage:
     python tools/check_events.py logs/*.jsonl
     python tools/check_events.py --strict run.jsonl   # free-form lines
@@ -29,7 +35,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from attacking_federate_learning_tpu.utils.metrics import (  # noqa: E402
-    SCHEMA_VERSION, validate_event
+    SCHEMA_VERSION, SUPPORTED_VERSIONS, validate_event
 )
 
 
@@ -66,7 +72,8 @@ def check_file(path, strict=False):
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description=f"Validate run JSONLs against the event schema "
-                    f"(v{SCHEMA_VERSION}).")
+                    f"(v{min(SUPPORTED_VERSIONS)}-v{max(SUPPORTED_VERSIONS)}"
+                    f"; writer stamps v{SCHEMA_VERSION}).")
     p.add_argument("paths", nargs="+", metavar="JSONL")
     p.add_argument("--strict", action="store_true",
                    help="rows without a 'kind' field are errors, not "

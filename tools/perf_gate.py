@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Deterministic perf-regression gate: static HLO facts, no stopwatch.
+
+Wall-clock benchmarks on this box are unusable as a gate (one shared
+core, rare TPU relay windows, BENCH_*.json noise), so this gate replays
+a pinned set of small configs, extracts each compiled entry point's
+STATIC cost facts (utils/costs.py: cost_analysis FLOPs / bytes
+accessed, memory_analysis buffer sizes) and diffs them against the
+checked-in ``PERF_BASELINE.json``:
+
+- ``flops`` / ``bytes_accessed`` / ``argument_bytes`` / ``output_bytes``
+  must match EXACTLY — they are pure functions of (HLO, XLA version,
+  platform), so any drift is a real change to the compiled program
+  (e.g. a defense kernel growing a second distance computation);
+- ``temp_bytes`` / ``peak_bytes`` compare within ``--tolerance``
+  (default 5%) — buffer assignment may legally wiggle with scheduling.
+
+The baseline records the environment it was generated in (jax/jaxlib
+version, platform).  On a mismatched environment the comparison is
+meaningless (XLA's cost model changed under us), so the gate SKIPS with
+a loud notice and exit 0 unless ``--strict-env`` — regenerate with
+``--update`` after a toolchain bump.
+
+Usage:
+    python tools/perf_gate.py                  # gate against baseline
+    python tools/perf_gate.py --update         # (re)generate baseline
+    python tools/perf_gate.py --cells krum,bulyan --tolerance 0.1
+
+Exit status: 0 clean (or env-skip), 1 on any named regression, 2 when
+the baseline is missing (run --update first).  CI-wired via
+tests/test_costs.py next to the fault_matrix/check_events hooks;
+tools/smoke.sh runs all three.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BASELINE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "PERF_BASELINE.json")
+
+# The pinned cells: small enough to compile in CI time on CPU, wide
+# enough to cover the cost-relevant program families — the O(n^2 d)
+# distance defenses, the coordinate-wise sorts, the fused-vs-telemetry
+# round programs, and the plain mean.
+CELLS = {
+    "nodefense": dict(defense="NoDefense"),
+    "krum": dict(defense="Krum"),
+    "trimmed_mean": dict(defense="TrimmedMean"),
+    "bulyan": dict(defense="Bulyan"),
+    "median": dict(defense="Median"),
+    "krum_telemetry": dict(defense="Krum", telemetry=True),
+}
+
+EXACT = ("flops", "bytes_accessed", "argument_bytes", "output_bytes")
+TOLERANT = ("temp_bytes", "peak_bytes")
+
+
+def environment() -> dict:
+    import importlib.metadata as md
+
+    import jax
+
+    def _v(pkg):
+        try:
+            return md.version(pkg)
+        except Exception:
+            return "unknown"
+
+    return {"jax": _v("jax"), "jaxlib": _v("jaxlib"),
+            "platform": jax.devices()[0].platform}
+
+
+def measure_cell(name: str, overrides: dict) -> dict:
+    """Build the pinned small experiment and return {entry: facts}."""
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.attacks import DriftAttack
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+
+    cfg = ExperimentConfig(
+        dataset=C.SYNTH_MNIST, users_count=11, mal_prop=0.2,
+        batch_size=16, epochs=5, test_step=5, seed=0,
+        synth_train=256, synth_test=64, **overrides)
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=256, synth_test=64)
+    exp = FederatedExperiment(cfg, attacker=DriftAttack(1.5), dataset=ds)
+    ledger = exp.cost_report()
+    if ledger.errors:
+        msgs = "; ".join(f"{n}: {m}" for n, m in ledger.errors)
+        raise RuntimeError(f"cell {name}: cost analysis failed ({msgs})")
+    return ledger.summary()
+
+
+def measure(cells) -> dict:
+    out = {}
+    for name in cells:
+        out[name] = measure_cell(name, CELLS[name])
+        print(f"  measured {name}: "
+              + "  ".join(f"{e}={f['flops']:.3e}f"
+                          for e, f in out[name].items()))
+    return out
+
+
+def diff(baseline: dict, measured: dict, tolerance: float) -> list:
+    """Returns a list of '<cell>.<entry>.<metric>: ...' regression
+    strings (empty = clean).  Missing/extra entries are regressions
+    too — a silently vanished entry point must not pass the gate."""
+    problems = []
+    for cell, entries in baseline.items():
+        if cell not in measured:
+            problems.append(f"{cell}: cell not measured")
+            continue
+        got_entries = measured[cell]
+        for entry, want in entries.items():
+            got = got_entries.get(entry)
+            if got is None:
+                problems.append(f"{cell}.{entry}: entry point missing "
+                                f"from the measured ledger")
+                continue
+            for metric in EXACT:
+                if got.get(metric) != want.get(metric):
+                    problems.append(
+                        f"{cell}.{entry}.{metric}: measured "
+                        f"{got.get(metric)} != baseline "
+                        f"{want.get(metric)} (exact-match metric)")
+            for metric in TOLERANT:
+                w, g = want.get(metric), got.get(metric)
+                if w in (None, 0):
+                    if g != w:
+                        problems.append(
+                            f"{cell}.{entry}.{metric}: measured {g} != "
+                            f"baseline {w}")
+                    continue
+                rel = abs(g - w) / abs(w)
+                if rel > tolerance:
+                    problems.append(
+                        f"{cell}.{entry}.{metric}: measured {g} vs "
+                        f"baseline {w} ({100 * rel:.1f}% > "
+                        f"{100 * tolerance:.0f}% tolerance)")
+        for entry in got_entries:
+            if entry not in entries:
+                problems.append(f"{cell}.{entry}: new entry point not in "
+                                f"baseline (regenerate with --update)")
+    return problems
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Deterministic (static-HLO) perf-regression gate "
+                    "over pinned small configs (utils/costs.py).")
+    p.add_argument("--baseline", default=BASELINE)
+    p.add_argument("--update", action="store_true",
+                   help="write a fresh baseline instead of gating")
+    p.add_argument("--cells", default=",".join(CELLS),
+                   help="comma-separated subset of the pinned cells")
+    p.add_argument("--tolerance", type=float, default=0.05,
+                   help="relative tolerance for the memory metrics "
+                        "(FLOPs/bytes are always exact)")
+    p.add_argument("--strict-env", action="store_true",
+                   help="treat a baseline/environment mismatch as a "
+                        "failure instead of a skip")
+    args = p.parse_args(argv)
+
+    cells = [c.strip() for c in args.cells.split(",") if c.strip()]
+    unknown = [c for c in cells if c not in CELLS]
+    if unknown:
+        print(f"unknown cells: {unknown} (known: {sorted(CELLS)})")
+        return 2
+
+    env = environment()
+    if args.update:
+        measured = measure(cells)
+        payload = {"env": env, "tolerance": args.tolerance,
+                   "cells": measured}
+        with open(args.baseline, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.baseline} "
+              f"({sum(len(v) for v in measured.values())} entry points, "
+              f"jax {env['jax']}, {env['platform']})")
+        return 0
+
+    if not os.path.exists(args.baseline):
+        print(f"no baseline at {args.baseline}; run with --update first")
+        return 2
+    with open(args.baseline) as f:
+        base = json.load(f)
+    benv = base.get("env", {})
+    if benv != env:
+        msg = (f"environment mismatch: baseline {benv} vs current {env} "
+               f"— static cost facts are only comparable within one "
+               f"(jax, platform) pair; regenerate with --update")
+        if args.strict_env:
+            print(f"FAIL perf_gate: {msg}")
+            return 1
+        print(f"SKIP perf_gate: {msg}")
+        return 0
+
+    baseline_cells = {c: v for c, v in base["cells"].items() if c in cells}
+    measured = measure(cells)
+    problems = diff(baseline_cells, measured, args.tolerance)
+    if problems:
+        print(f"FAIL perf_gate: {len(problems)} regression(s)")
+        for prob in problems:
+            print(f"  {prob}")
+        return 1
+    n = sum(len(v) for v in measured.values())
+    print(f"ok   perf_gate: {len(cells)} cells, {n} entry points match "
+          f"the baseline (FLOPs/bytes exact, memory within "
+          f"{100 * args.tolerance:.0f}%)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
