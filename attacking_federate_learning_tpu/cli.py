@@ -139,7 +139,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["auto", "cpu", "tpu"],
                    help="JAX platform; must be chosen before jax initializes")
     p.add_argument("--mesh-shape", default=None, type=str,
-                   help="'clients,model' device split, e.g. 8,1")
+                   help="'clients,model' device split, e.g. 8,1; "
+                        "'none' clears an earlier --mesh-shape (argparse "
+                        "last-wins — the supervisor's OOM degradation "
+                        "appends it to relax the MeshPlan)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize client activations in the backward "
                         "pass (jax.checkpoint) — trades FLOPs for HBM at "
@@ -289,12 +292,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "(round, rounds/s EMA, rss, last-event age) so "
                         "a stalled run is distinguishable from a long "
                         "compile by tailing the events file; 0 = off")
+    p.add_argument("--journal", action="store_true",
+                   help="keep an append-only per-run journal + resume "
+                        "manifest under runs/<run-id>/ "
+                        "(utils/lifecycle.py): rounds and evals are "
+                        "committed exactly once across any number of "
+                        "restarts, and a resumed run never re-emits "
+                        "events a previous attempt already recorded")
+    p.add_argument("--run-id", default=None, metavar="ID",
+                   help="journal identity override (implies --journal); "
+                        "default derives from the config hash.  The "
+                        "supervisor pins this so degraded restarts "
+                        "(halved batch, CPU fallback) still share one "
+                        "journal")
     return p
 
 
 def config_from_args(args) -> ExperimentConfig:
-    mesh_shape = (tuple(int(x) for x in args.mesh_shape.split(","))
-                  if args.mesh_shape else None)
+    mesh_shape = None
+    if args.mesh_shape and args.mesh_shape.lower() != "none":
+        mesh_shape = tuple(int(x) for x in args.mesh_shape.split(","))
     faults = None
     if args.fault_dropout or args.fault_straggler or args.fault_corrupt:
         faults = C.FaultConfig(dropout=args.fault_dropout,
@@ -411,6 +428,10 @@ def main(argv=None):
     )
     from attacking_federate_learning_tpu.data.datasets import load_dataset
     from attacking_federate_learning_tpu.utils.checkpoint import Checkpointer
+    from attacking_federate_learning_tpu.utils.lifecycle import (
+        EXIT_DIVERGED, EXIT_PREEMPTED, GracefulShutdown, Preempted,
+        RunJournal, run_id_for
+    )
     from attacking_federate_learning_tpu.utils.metrics import RunLogger
     from attacking_federate_learning_tpu.utils.profiling import (
         PhaseTimer, xla_trace
@@ -486,8 +507,44 @@ def main(argv=None):
             for name, msg in ledger.errors:
                 logger.print(f"[cost] {name}: analysis failed: {msg}")
         timer = PhaseTimer() if args.profile else None
-        with xla_trace(args.trace_dir):
-            result = exp.run(logger, checkpointer=checkpointer, timer=timer)
+        # Run-lifecycle layer (utils/lifecycle.py): the journal is
+        # opt-in (--journal / --run-id); graceful SIGTERM/SIGINT
+        # handling is always on for a CLI-driven run — a signal lands
+        # as a checkpoint + 'preempted' exit (75) at the next span
+        # boundary instead of a lost run.  FL_PREEMPT_AT_ROUND is the
+        # deterministic injection seam (tests, tools/crash_matrix.py,
+        # the capture rehearsal drill).
+        journal = None
+        if args.journal or args.run_id:
+            journal = RunJournal(cfg.run_dir,
+                                 args.run_id or run_id_for(cfg))
+            logger.print(f"[lifecycle] journal {journal.dir} "
+                         f"(attempts so far: {journal.attempt})")
+        pre_at = os.environ.get("FL_PREEMPT_AT_ROUND")
+        shutdown = GracefulShutdown(
+            preempt_at_round=int(pre_at) if pre_at else None)
+        try:
+            with xla_trace(args.trace_dir), shutdown:
+                result = exp.run(logger, checkpointer=checkpointer,
+                                 timer=timer, journal=journal,
+                                 shutdown=shutdown)
+        except Preempted as e:
+            # Graceful shutdown honored: state checkpointed, journal
+            # marked; EX_TEMPFAIL tells the supervisor "resume me".
+            logger.print(f"[lifecycle] {e}")
+            raise SystemExit(EXIT_PREEMPTED)
+        except FloatingPointError as e:
+            # Deterministic numeric failure (watchdog rollbacks
+            # exhausted, or the backdoor shadow-train nan guard):
+            # retrying the identical config reproduces it, so the exit
+            # code tells the supervisor NOT to retry.
+            logger.record(kind="lifecycle", phase="fatal",
+                          failure="divergence", error=str(e))
+            logger.print(f"[lifecycle] fatal (divergence): {e}")
+            if journal is not None:
+                journal.finish("diverged", EXIT_DIVERGED, error=str(e))
+                journal.close()
+            raise SystemExit(EXIT_DIVERGED)
         if timer is not None:
             # finish() (run's success path) leaves the tee open for
             # exactly this trailing summary; __exit__ closes it.

@@ -1161,13 +1161,27 @@ class FederatedExperiment:
             malicious_picks=sum(1 for w in wins if w < self.m_mal))
 
     def run(self, logger: Optional[RunLogger] = None,
-            checkpointer=None, timer=None) -> dict:
+            checkpointer=None, timer=None, journal=None,
+            shutdown=None) -> dict:
         """Full experiment loop (reference main.py:64-95).
 
         ``timer``: an optional utils.profiling.PhaseTimer; per-phase
         wall-clock (round / eval, device-synchronized) is accumulated and
         written as a structured record at the end (the reference's only
         timing artifact is one timestamp, main.py:97).
+
+        ``journal``: an optional utils.lifecycle.RunJournal — rounds and
+        evals are committed at host boundaries with exactly-once
+        semantics across restarts, and per-round event emission is
+        gated by the journal's high-water mark so a resumed run never
+        re-emits what a previous attempt already recorded.  None (the
+        default) leaves every pre-lifecycle caller untouched.
+
+        ``shutdown``: an optional utils.lifecycle.GracefulShutdown; its
+        request flag is polled at each span boundary — when set, the
+        engine auto-checkpoints, records a 'lifecycle' preempt event,
+        marks the journal 'preempted' and raises
+        utils.lifecycle.Preempted (the CLI maps it to exit code 75).
 
         Logger ownership: a logger the engine creates itself is managed
         with ``with`` (crash-safe close — JSONL handle closed, accuracy
@@ -1192,9 +1206,37 @@ class FederatedExperiment:
             if own_logger:
                 stack.enter_context(logger)
             return self._run_body(logger, checkpointer, timer, phase,
-                                  test_size)
+                                  test_size, journal, shutdown)
 
-    def _run_body(self, logger, checkpointer, timer, phase, test_size):
+    def _preempt(self, logger, checkpointer, epoch, journal, shutdown):
+        """Honor a graceful-shutdown request at a span boundary: persist
+        an auto-checkpoint (creating a Checkpointer if the caller runs
+        without one — a preempt that loses the run would defeat the
+        point), flush a 'lifecycle' preempt event, mark the journal and
+        raise Preempted (utils/lifecycle.py)."""
+        from attacking_federate_learning_tpu.utils.checkpoint import (
+            Checkpointer
+        )
+        from attacking_federate_learning_tpu.utils.lifecycle import (
+            EXIT_PREEMPTED, Preempted
+        )
+
+        ck = checkpointer or Checkpointer(self.cfg)
+        path = ck.save_auto(self.state, extra=self.fault_state_host())
+        source = shutdown.source or "signal"
+        logger.record(kind="lifecycle", phase="preempt", round=int(epoch),
+                      source=source, checkpoint=path,
+                      attempt=journal.attempt if journal is not None else 1)
+        logger.print(f"!! preempted ({source}) after round {epoch}; "
+                     f"state checkpointed to {path}; "
+                     f"exiting {EXIT_PREEMPTED} (resumable)")
+        if journal is not None:
+            journal.finish("preempted", EXIT_PREEMPTED, checkpoint=path)
+            journal.close()
+        raise Preempted(epoch, source)
+
+    def _run_body(self, logger, checkpointer, timer, phase, test_size,
+                  journal=None, shutdown=None):
         cfg = self.cfg
         if cfg.backdoor:
             # Pre-training accuracy line (reference main.py:45-51).
@@ -1222,6 +1264,28 @@ class FederatedExperiment:
             self._last_good = (self._host_copy(self.state),
                                self.fault_state_host())
         epoch = int(self.state.round)
+        start_epoch = epoch
+        if journal is not None:
+            attempt = journal.start_attempt(epoch)
+            phase_name = ("start" if attempt == 1 and epoch == 0
+                          else "resume")
+            logger.record(kind="lifecycle", phase=phase_name,
+                          round=epoch, attempt=attempt,
+                          replay_high=journal.high)
+            if phase_name == "resume":
+                logger.print(
+                    f"[lifecycle] attempt {attempt} resumes at round "
+                    f"{epoch} (journal high-water {journal.high}: "
+                    f"replayed rounds/evals are not re-recorded)")
+
+        def fresh(t):
+            # Exactly-once event emission across restarts: a round at or
+            # below the journal's high-water mark was already recorded
+            # by the attempt that committed it (deterministic replay
+            # recomputes the identical values — re-emitting would
+            # double-count them downstream).
+            return journal is None or journal.fresh_round(t)
+
         while epoch < cfg.epochs:
             if use_spans:
                 # Advance to the next eval boundary in one device
@@ -1248,24 +1312,31 @@ class FederatedExperiment:
                     t0, stacked = self.last_span_telemetry
                     host = jax.tree.map(np.asarray, stacked)
                     for i in range(boundary - epoch + 1):
-                        self._emit_round_telemetry(
-                            logger, t0 + i,
-                            jax.tree.map(lambda a: a[i], host))
+                        if fresh(t0 + i):
+                            self._emit_round_telemetry(
+                                logger, t0 + i,
+                                jax.tree.map(lambda a: a[i], host))
                     self.last_span_telemetry = None
+                if journal is not None:
+                    journal.commit_rounds(epoch, boundary)
                 epoch = boundary
             else:
                 with phase("round"):
                     self.run_round(epoch)
-                if cfg.log_round_stats and self.last_round_stats is not None:
+                if (cfg.log_round_stats and fresh(epoch)
+                        and self.last_round_stats is not None):
                     logger.record(kind="round", round=epoch,
                                   **{k: float(v) for k, v in
                                      self.last_round_stats.items()})
                 if ((cfg.telemetry or self.faults is not None)
+                        and fresh(epoch)
                         and self.last_round_telemetry is not None):
                     self._emit_round_telemetry(
                         logger, epoch,
                         jax.tree.map(np.asarray,
                                      self.last_round_telemetry))
+                if journal is not None:
+                    journal.commit_rounds(epoch, epoch)
 
             if watchdog_on and self._diverged():
                 # Graceful degradation: restore the last good state and
@@ -1276,7 +1347,12 @@ class FederatedExperiment:
                 epoch = int(self.state.round)
                 continue
 
-            if epoch % cfg.test_step == 0 or epoch == cfg.epochs - 1:
+            if ((epoch % cfg.test_step == 0 or epoch == cfg.epochs - 1)
+                    and (journal is None or journal.fresh_eval(epoch))):
+                # Replayed evals (journal) are skipped entirely: eval is
+                # pure observation of the deterministically-recomputed
+                # state, so re-running it would only duplicate 'eval'
+                # events and burn the resume window.
                 # The lambda reads `correct` after the block assigns it, so
                 # the timer blocks on the eval outputs, not stale state.
                 with phase("eval", lambda: correct):
@@ -1293,6 +1369,8 @@ class FederatedExperiment:
                                                  logger=logger, tag="POST")
                     logger.record(kind="asr", round=epoch,
                                   attack_success_rate=float(asr))
+                if journal is not None:
+                    journal.commit_eval(epoch)
             if ckpt_every and epoch % ckpt_every == 0:
                 # Periodic auto-checkpoint (atomic + rotated,
                 # utils/checkpoint.py) — the watchdog above has already
@@ -1303,6 +1381,14 @@ class FederatedExperiment:
                 if checkpointer is not None:
                     checkpointer.save_auto(self.state,
                                            extra=self._last_good[1])
+            if (shutdown is not None
+                    and shutdown.should_preempt(start_epoch, epoch)):
+                # Span boundary = the only place a checkpoint is
+                # coherent (state.round == epoch + 1, fault ring buffer
+                # at the matching phase); a signal that landed mid-span
+                # waited here.
+                self._preempt(logger, checkpointer, epoch, journal,
+                              shutdown)
             epoch += 1
 
         if self.cfg.telemetry:
@@ -1314,6 +1400,12 @@ class FederatedExperiment:
             # (VERDICT r2 #3's stream-stall measurement; near-zero stall
             # per get means the prefetch pipeline kept up.)
             logger.record(kind="stream", **self.stream.stall_stats())
+        if journal is not None:
+            logger.record(kind="lifecycle", phase="complete",
+                          round=int(self.state.round) - 1,
+                          attempt=journal.attempt)
+            journal.finish("done")
+            journal.close()
         logger.finish()
         return {"accuracies": logger.accuracies,
                 "epochs": logger.accuracies_epochs,

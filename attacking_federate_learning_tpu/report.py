@@ -142,6 +142,33 @@ def compile_cost(events):
     }
 
 
+def lifecycle_summary(events):
+    """Run-lifecycle rollup from 'lifecycle' events (schema v3,
+    utils/lifecycle.py): per-phase transition counts, the attempt
+    count, any degradations applied, and failure classes seen — one
+    glance answers "did this run preempt/resume/degrade, and how many
+    times did the supervisor have to step in".  Returns None when the
+    run recorded no lifecycle events (unsupervised, pre-v3)."""
+    lcs = [e for e in events if e.get("kind") == "lifecycle"]
+    if not lcs:
+        return None
+    out = {"transitions": len(lcs),
+           "phases": dict(Counter(e["phase"] for e in lcs)),
+           "last_phase": lcs[-1]["phase"]}
+    attempts = [e["attempt"] for e in lcs
+                if isinstance(e.get("attempt"), (int, float))]
+    if attempts:
+        out["attempts"] = int(max(attempts))
+    degradations = [e.get("step") for e in lcs
+                    if e["phase"] == "degrade" and e.get("step")]
+    if degradations:
+        out["degradations"] = degradations
+    failures = [e["failure"] for e in lcs if e.get("failure")]
+    if failures:
+        out["failures"] = dict(Counter(failures))
+    return out
+
+
 def heartbeat_summary(events):
     """Liveness rollup from 'heartbeat' events: count, max last-event
     age (the stall witness) and the final rounds/s EMA."""
@@ -198,6 +225,9 @@ def summarize_run(events):
     cc = compile_cost(events)
     if cc:
         out["compile_cost"] = cc
+    lc = lifecycle_summary(events)
+    if lc:
+        out["lifecycle"] = lc
     hb = heartbeat_summary(events)
     if hb:
         out["heartbeat"] = hb
@@ -264,6 +294,21 @@ def _print_run(path, s, out):
             out(f"    {r['name']:16s} flops {flops:>10s}   "
                 f"bytes {byts:>10s}   peak {peak}   "
                 f"compile {comp} ({r.get('cache', '-')})")
+    lc = s.get("lifecycle")
+    if lc:
+        phases = "  ".join(f"{k}:{v}" for k, v in sorted(
+            lc["phases"].items()))
+        line = (f"  lifecycle: {phases}  (last {lc['last_phase']}")
+        if "attempts" in lc:
+            line += f", {lc['attempts']} attempt(s)"
+        line += ")"
+        out(line)
+        if "degradations" in lc:
+            out(f"    degradations: {', '.join(lc['degradations'])}")
+        if "failures" in lc:
+            fl = "  ".join(f"{k}:{v}" for k, v in sorted(
+                lc["failures"].items()))
+            out(f"    failures seen: {fl}")
     hb = s.get("heartbeat")
     if hb:
         line = (f"  heartbeat: {hb['beats']} beats, max event age "

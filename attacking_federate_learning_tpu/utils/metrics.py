@@ -23,9 +23,12 @@ profile/stream/defense/attack/selection_hist, later fault); v2 adds the
 compile-and-cost observatory kinds — ``compile`` (per-entry-point
 compile wall time + persistent-cache attribution), ``cost`` (static HLO
 FLOPs / bytes-accessed / memory facts, utils/costs.py) and
-``heartbeat`` (the RunLogger liveness thread).  Readers accept both
-versions; v1 events simply never carry the v2 kinds, and a v2 kind
-stamped v1 is an emitter bug, rejected.
+``heartbeat`` (the RunLogger liveness thread); v3 adds ``lifecycle``
+(run-lifecycle transitions — start/resume/preempt/complete from the
+engine, retry/degrade/exhausted from tools/supervisor.py;
+utils/lifecycle.py).  Readers accept every version; older logs simply
+never carry the newer kinds, and a newer-only kind stamped with an
+older version is an emitter bug, rejected (``KIND_MIN_VERSION``).
 """
 
 from __future__ import annotations
@@ -40,8 +43,8 @@ from typing import Optional
 import numpy as np
 
 
-SCHEMA_VERSION = 2
-SUPPORTED_VERSIONS = (1, 2)
+SCHEMA_VERSION = 3
+SUPPORTED_VERSIONS = (1, 2, 3)
 
 # kind -> required fields.  Producers: core/engine.py (round, eval, asr,
 # profile, stream, defense, attack, selection_hist via RunLogger).
@@ -79,11 +82,24 @@ EVENT_KINDS = {
     # capture is distinguishable from a long compile by tailing the
     # events file (round / rounds-per-sec EMA ride along when known)
     "heartbeat": {"rss_mb", "last_event_age_s"},
+    # --- v3: the run-lifecycle layer (utils/lifecycle.py) --------------
+    # one transition of the preemption-safe run lifecycle.  'phase' is
+    # the transition name: the engine emits start/resume/preempt/
+    # complete (core/engine.py), the supervisor retry/degrade/
+    # stall_kill/exhausted/fatal (tools/supervisor.py).  Extra fields
+    # (round, attempt, signal, failure class, degradation applied) ride
+    # along as diagnostics.
+    "lifecycle": {"phase"},
 }
 
-# Kinds introduced by schema v2; an event carrying one of these but
-# stamped v1 is an emitter bug (a v1 writer cannot know these kinds).
-V2_KINDS = {"compile", "cost", "heartbeat"}
+# Minimum schema version per kind introduced after v1; an event carrying
+# one of these but stamped with an older version is an emitter bug (an
+# older writer cannot know these kinds).
+KIND_MIN_VERSION = {"compile": 2, "cost": 2, "heartbeat": 2,
+                    "lifecycle": 3}
+
+# Back-compat alias (pre-v3 spelling used by external readers).
+V2_KINDS = {k for k, v in KIND_MIN_VERSION.items() if v == 2}
 
 
 def validate_event(rec) -> dict:
@@ -108,10 +124,12 @@ def validate_event(rec) -> dict:
         raise ValueError(
             f"unknown event kind {kind!r} (schema v{SCHEMA_VERSION}; "
             f"known: {sorted(EVENT_KINDS)})")
-    if kind in V2_KINDS and v < 2:
+    min_v = KIND_MIN_VERSION.get(kind, 1)
+    if v < min_v:
         raise ValueError(
-            f"{kind!r} events need schema v2, but this one is stamped "
-            f"v{v} (emitter bug: a v1 writer cannot produce this kind)")
+            f"{kind!r} events need schema v{min_v}, but this one is "
+            f"stamped v{v} (emitter bug: a v{v} writer cannot produce "
+            f"this kind)")
     missing = EVENT_KINDS[kind] - rec.keys()
     if missing:
         raise ValueError(

@@ -155,11 +155,181 @@ def test_engine_blockwise_requires_mesh():
         _one_round_weights("ring", mesh_shape=None)
 
 
+class _BulyanEngineProbe:
+    """One engine, stepped round by round with its realized Bulyan
+    selection observable (the telemetry seam's multi-hot mask) and the
+    pre-defense gradient matrix recomputable on the host for the tie
+    replay.  Telemetry does not perturb the trajectory (PR-1 pin)."""
+
+    def __init__(self, distance_impl, mesh_shape=None):
+        from attacking_federate_learning_tpu import config as C
+        from attacking_federate_learning_tpu.attacks import DriftAttack
+        from attacking_federate_learning_tpu.config import ExperimentConfig
+        from attacking_federate_learning_tpu.core.engine import (
+            FederatedExperiment
+        )
+        from attacking_federate_learning_tpu.data.datasets import (
+            load_dataset
+        )
+
+        cfg = ExperimentConfig(dataset=C.SYNTH_MNIST, users_count=16,
+                               mal_prop=0.2, batch_size=16, epochs=2,
+                               defense="Bulyan",
+                               distance_impl=distance_impl,
+                               mesh_shape=mesh_shape, telemetry=True,
+                               synth_train=1024, synth_test=128)
+        ds = load_dataset(cfg.dataset, seed=0, synth_train=1024,
+                          synth_test=128)
+        self.exp = FederatedExperiment(cfg, attacker=DriftAttack(1.0),
+                                       dataset=ds)
+
+    def pre_defense_grads(self, t):
+        exp = self.exp
+        grads = exp._compute_grads_impl(exp.state, t)
+        grads = exp.attacker.apply(grads, exp.m_mal,
+                                   exp._ctx_for(exp.state, t))
+        return np.asarray(grads, np.float64)
+
+    def step(self, t):
+        """Run round t; returns the frozen selection set."""
+        self.exp.run_round(t)
+        mask = np.asarray(
+            self.exp.last_round_telemetry["defense_selection_mask"])
+        return frozenset(np.flatnonzero(mask > 0).tolist())
+
+    @property
+    def weights(self):
+        return np.asarray(self.exp.state.weights)
+
+
+def _bulyan_selection_steps(G, n, f):
+    """Host replay of the Bulyan selection loop with BOTH f32 distance
+    formulations the engines use (direct difference vs Gram — the
+    bench.py:adjudicate_f32_flip template): per selection step, the
+    top-2 mid-score gap against the measured indeterminacy band
+    (4x the |diff-form - Gram-form| spread on this very data, plus the
+    analytic worst-case f32 summation term).  Scores sum in float64 so
+    each formulation's own error is isolated.  Returns
+    [(pick, runner_up, gap, band), ...] for the set_size steps."""
+    G32 = np.asarray(G, np.float32)
+    d_diff = np.sqrt(((G32[:, None, :] - G32[None, :, :]) ** 2)
+                     .sum(-1, dtype=np.float32))
+    sq = (G32 * G32).sum(1, dtype=np.float32)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * (G32 @ G32.T)
+    d_gram = np.sqrt(np.maximum(d2, 0.0, dtype=np.float32))
+    eps32 = float(np.finfo(np.float32).eps)
+    alive = np.ones(n, bool)
+    steps = []
+    for s in range(n - 2 * f):
+        n_cur = n - s
+        k = n_cur - f          # reference n-f scoring quirk, shrinking n
+        mids, spreads, absmax = {}, [], 0.0
+        for i in range(n):
+            if not alive[i]:
+                continue
+            pair = []
+            for D in (d_diff, d_gram):
+                v = np.asarray([D[i, j] for j in range(n)
+                                if j != i and alive[j]], np.float64)
+                pair.append(float(np.sort(v)[:k].sum()))
+            mids[i] = 0.5 * (pair[0] + pair[1])
+            spreads.append(abs(pair[0] - pair[1]))
+            absmax = max(absmax, abs(pair[0]), abs(pair[1]))
+        order = sorted(mids, key=mids.__getitem__)
+        gap = mids[order[1]] - mids[order[0]]
+        band = 4.0 * max(spreads) + 0.5 * n_cur * eps32 * absmax
+        steps.append((order[0], order[1], gap, band))
+        alive[order[0]] = False
+    return steps
+
+
+def _adjudicate_trim_flips(G_ref, G_got, sel, f, w_ref, w_got, lr):
+    """Adjudicate per-coordinate trimmed-mean keep-set flips (the
+    second place two correct engines can legally diverge): the two
+    engines' gradient matrices already differ at the ulp level (the
+    mesh-sharded and single-device reductions order sums differently),
+    and a coordinate whose trim boundary — the gap between the keep-th
+    and (keep+1)-th smallest |deviation-from-median| — sits inside
+    that measured perturbation band can legally keep DIFFERENT rows,
+    moving the aggregate by up to the boundary pair's combined
+    deviation over the keep count.  Same measured-band standard as
+    bench.py:adjudicate_f32_flip.  Returns indices of coordinates
+    whose weight difference is NOT attributable to a legal flip."""
+    S = sorted(sel)
+    rows_ref = G_ref[S]
+    rows_got = G_got[S]
+    f2 = 2 * f
+    keep = len(S) - f2 - 1
+    eps32 = float(np.finfo(np.float32).eps)
+    med = np.median(rows_ref, axis=0)
+    a = np.sort(np.abs(rows_ref - med), axis=0)
+    gap = a[keep] - a[keep - 1]          # trim-boundary gap, per coord
+    # Measured input indeterminacy (x16 safety, same spirit as the x4
+    # on the measured score spread in adjudicate_f32_flip — the median
+    # and every deviation shift with the perturbation).
+    band = 16.0 * (np.abs(rows_ref - rows_got).max(axis=0)
+                   + eps32 * np.abs(rows_ref).max(axis=0))
+    dw = np.abs(w_ref.astype(np.float64) - w_got.astype(np.float64))
+    strict = 2e-5 + 1e-5 * np.abs(w_ref)     # the summation-noise floor
+    # One boundary swap changes the kept mean by at most the boundary
+    # pair's combined |dev| / keep; the weight moves lr x that.
+    envelope = lr * (a[keep] + a[keep - 1] + 2.0 * band) / keep + strict
+    viol = dw > strict
+    illegal = viol & ((gap > band) | (dw > envelope))
+    return np.flatnonzero(illegal), int(viol.sum())
+
+
 def test_engine_bulyan_blockwise():
-    ref = _one_round_weights("auto", defense="Bulyan")
-    got = _one_round_weights("allgather", mesh_shape=(8, 1),
-                             defense="Bulyan")
-    np.testing.assert_allclose(got, ref, atol=2e-5, rtol=1e-5)
+    """Blockwise-allgather D vs the in-program xla D, wired through the
+    engine under Bulyan.  Two correct f32 engines may legally disagree
+    wherever a selection rests on a near-tie (ARCHITECTURE.md "Known
+    local failures"; the ulp-band reality tests/test_native.py pins),
+    and Bulyan selects twice: the shrinking-pool Krum selection, and
+    the per-coordinate trimmed-mean keep set — on iid gaussian-ish
+    gradients the trim boundary is near-tied on a sizable fraction of
+    coordinates, so a blanket 2e-5 weight tolerance mis-adjudicates
+    legal flips as kernel bugs.  Instead (bench.py:adjudicate_f32_flip
+    is the template — measured indeterminacy bands, not guessed
+    tolerances):
+
+    1. the realized SELECTION SETS (telemetry masks) are compared per
+       round; a set flip is legal only if the host replay of the
+       selection (both f32 distance formulations, f64 score sums)
+       shows a step whose top-2 score gap is inside its band;
+    2. with identical selection sets, every coordinate whose weights
+       differ beyond summation noise must sit on a trim boundary
+       within the measured inter-engine perturbation band AND inside
+       the single-swap envelope.
+
+    A decisive-gap disagreement still fails either stage — that would
+    be a wrong kernel, not a tie."""
+    ref = _BulyanEngineProbe("auto")
+    got = _BulyanEngineProbe("allgather", mesh_shape=(8, 1))
+    n, f = 16, ref.exp.m_mal
+    lr = ref.exp.cfg.learning_rate
+    for t in range(2):
+        G_ref = ref.pre_defense_grads(t)
+        G_got = got.pre_defense_grads(t)
+        sel_ref, sel_got = ref.step(t), got.step(t)
+        if sel_ref != sel_got:
+            steps = _bulyan_selection_steps(G_ref, n, f)
+            tied = [(p, q, g, b) for p, q, g, b in steps if g <= b]
+            assert tied, (
+                f"round {t}: selection flip {sorted(sel_ref ^ sel_got)} "
+                f"with every step's top-2 gap DECISIVE (no step inside "
+                f"its indeterminacy band): {steps}")
+            return     # states legally diverged; later rounds can't compare
+        illegal, n_viol = _adjudicate_trim_flips(
+            G_ref, G_got, sel_ref, f, ref.weights, got.weights, lr)
+        assert illegal.size == 0, (
+            f"round {t}: {illegal.size}/{n_viol} diverging coordinates "
+            f"are NOT legal trim-boundary ties (first: "
+            f"{illegal[:5].tolist()}) — decisive disagreement between "
+            f"the distance engines")
+        if n_viol:
+            return     # legally diverged at the trim stage; stop comparing
+    np.testing.assert_allclose(got.weights, ref.weights,
+                               atol=2e-5, rtol=1e-5)
 
 
 def test_engine_blockwise_requires_divisible_cohort():

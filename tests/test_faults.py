@@ -313,6 +313,76 @@ def test_resume_after_kill_bit_for_bit(tmp_path):
                                   v_full)
 
 
+def test_sigterm_preempt_resume_bit_for_bit(tmp_path):
+    """SIGTERM-at-arbitrary-round (ISSUE 4 acceptance): a faulted run
+    gracefully preempted at a seeded-random round and restarted
+    finishes with final weights bit-for-bit equal to the uninterrupted
+    run, and its journal + event stream record every round and eval
+    exactly once across the two attempts.  Extends the SIGKILL+resume
+    test above: SIGKILL loses work back to the last auto-checkpoint;
+    the graceful path (utils/lifecycle.py) loses nothing — the preempt
+    boundary IS a checkpoint."""
+    from attacking_federate_learning_tpu.utils.lifecycle import (
+        GracefulShutdown, Preempted, RunJournal
+    )
+
+    kill_round = int(np.random.default_rng(11).integers(1, 9))
+    fc = FaultConfig(dropout=0.2, straggler=0.15, corrupt=0.05)
+
+    def cfg_for(run_dir):
+        # Distinct run dirs: runs/<dataset>/ is shared, and the
+        # reference run's checkpoints must not become the supervised
+        # run's resume targets.
+        return _cfg(tmp_path, users_count=12, epochs=10, test_step=5,
+                    defense="TrimmedMean", faults=fc, checkpoint_every=3,
+                    run_dir=str(tmp_path / run_dir))
+
+    cfg_ref = cfg_for("runs_ref")
+    full = FederatedExperiment(cfg_ref, attacker=DriftAttack(1.0))
+    with RunLogger(cfg_ref, None, cfg_ref.log_dir,
+                   jsonl_name="sig_full") as logger:
+        full.run(logger, checkpointer=Checkpointer(cfg_ref))
+    w_full = np.array(full.state.weights, copy=True)
+    v_full = np.array(full.state.velocity, copy=True)
+
+    cfg = cfg_for("runs_sup")
+    ck = Checkpointer(cfg)
+    exp = FederatedExperiment(cfg, attacker=DriftAttack(1.0))
+    with RunLogger(cfg, None, cfg.log_dir, jsonl_name="sig_sup") as logger:
+        with pytest.raises(Preempted):
+            exp.run(logger, checkpointer=ck,
+                    journal=RunJournal(cfg.run_dir, "sig"),
+                    shutdown=GracefulShutdown(
+                        preempt_at_round=kill_round))
+
+    resumed = FederatedExperiment(cfg, attacker=DriftAttack(1.0))
+    state, extra = ck.resume(ck.latest(), with_extra=True)
+    resumed.state = state
+    resumed.restore_fault_state(extra)
+    assert "stale" in extra                  # the ring buffer traveled
+    with RunLogger(cfg, None, cfg.log_dir, jsonl_name="sig_sup") as logger:
+        resumed.run(logger, checkpointer=ck,
+                    journal=RunJournal(cfg.run_dir, "sig"),
+                    shutdown=GracefulShutdown(
+                        preempt_at_round=kill_round))
+
+    np.testing.assert_array_equal(np.asarray(resumed.state.weights),
+                                  w_full)
+    np.testing.assert_array_equal(np.asarray(resumed.state.velocity),
+                                  v_full)
+    # Exactly-once: the journal audits clean, and the shared event
+    # stream (both attempts append to one JSONL) carries every round's
+    # fault event and every eval exactly once.
+    assert RunJournal(cfg.run_dir, "sig").verify(
+        epochs=10, test_step=5) == []
+    with open(os.path.join(cfg.log_dir, "sig_sup.jsonl")) as f:
+        events = [json.loads(line) for line in f]
+    fault_rounds = [e["round"] for e in events if e["kind"] == "fault"]
+    assert sorted(fault_rounds) == list(range(10))
+    eval_rounds = [e["round"] for e in events if e["kind"] == "eval"]
+    assert sorted(eval_rounds) == [0, 5, 9]
+
+
 def test_watchdog_rollback_then_abort(tmp_path):
     """Finite bit-scaled corruption under NoDefense explodes the server
     norm: the watchdog rolls back to the last good auto-checkpoint

@@ -1,0 +1,187 @@
+"""tools/supervisor.py: classification, backoff, the degradation
+ladder, resume gating, and the supervised crash-matrix smoke (one cell
+end to end through real subprocesses).
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+def _load(name):
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _opts(**kw):
+    kw.setdefault("raw", False)
+    kw.setdefault("max_retries", 3)
+    kw.setdefault("backoff_base", 2.0)
+    kw.setdefault("backoff_max", 60.0)
+    kw.setdefault("checkpoint_every", 5)
+    kw.setdefault("stall_timeout", 0.0)
+    kw.setdefault("stall_grace", 30.0)
+    kw.setdefault("poll_interval", 1.0)
+    kw.setdefault("run_id", None)
+    kw.setdefault("events", None)
+    kw.setdefault("verify_journal", False)
+    kw.setdefault("inject_preempt_round", None)
+    return argparse.Namespace(**kw)
+
+
+def _sup(sup_mod, child, **kw):
+    return sup_mod.Supervisor(_opts(**kw), child)
+
+
+CHILD = ["-s", "SYNTH_MNIST", "-e", "6", "-c", "32", "--backend", "cpu"]
+
+
+def test_degradation_ladder_oom_mesh_then_batch(tmp_path):
+    sup = _load("supervisor")
+    s = _sup(sup, CHILD + ["--mesh-shape", "8,1"],
+             events=str(tmp_path / "e.jsonl"))
+    # OOM #1: relax the MeshPlan first (cheapest semantic change).
+    assert s.degrade_for("oom") == "mesh_relaxed"
+    assert s.degrade_flags[-2:] == ["--mesh-shape", "none"]
+    # OOM #2+: halve the client-batch chunk, floor 1.
+    assert s.degrade_for("oom") == "batch_halved_to_16"
+    assert s.degrade_for("oom") == "batch_halved_to_8"
+    ns = s._effective_ns()
+    assert ns.batch_size == 8 and ns.mesh_shape == "none"
+
+
+def test_degradation_ladder_batch_floor(tmp_path):
+    sup = _load("supervisor")
+    s = _sup(sup, CHILD + ["-c", "1"], events=str(tmp_path / "e.jsonl"))
+    assert s.degrade_for("oom") is None        # floor: plain retry
+
+
+def test_degradation_ladder_backend_cpu_once(tmp_path):
+    sup = _load("supervisor")
+    s = _sup(sup, ["-s", "SYNTH_MNIST", "--backend", "tpu"],
+             events=str(tmp_path / "e.jsonl"))
+    assert s.degrade_for("backend") == "cpu_fallback"
+    assert s.degrade_flags[-2:] == ["--backend", "cpu"]
+    assert s.degrade_for("backend") is None    # already on CPU
+
+
+def test_degradation_ladder_stall_staged_on_repeat(tmp_path):
+    sup = _load("supervisor")
+    s = _sup(sup, CHILD, events=str(tmp_path / "e.jsonl"))
+    s.class_counts["stall"] = 1
+    assert s.degrade_for("stall") is None      # first stall: retry only
+    s.class_counts["stall"] = 2
+    assert s.degrade_for("stall") == "staged_fallback"
+    assert "--backdoor-staged" in s.degrade_flags
+    s.class_counts["stall"] = 3
+    assert s.degrade_for("stall") is None      # applied once
+
+
+def test_backoff_exponential_and_preempt_free(tmp_path):
+    sup = _load("supervisor")
+    s = _sup(sup, CHILD, backoff_base=2.0, backoff_max=9.0,
+             events=str(tmp_path / "e.jsonl"))
+    assert s.backoff("preempted") == 0.0
+    s.failures = 1
+    assert s.backoff("crash") == 2.0
+    s.failures = 2
+    assert s.backoff("crash") == 4.0
+    s.failures = 5
+    assert s.backoff("crash") == 9.0           # capped
+
+
+def test_resume_gated_on_own_progress(tmp_path):
+    """The first attempt must NOT adopt a stale checkpoint from some
+    other experiment in the shared runs/<dataset>/ dir; after this
+    run-id has progress (manifest exists), resume kicks in."""
+    sup = _load("supervisor")
+    child = CHILD + ["--run-dir", str(tmp_path / "runs")]
+    s = _sup(sup, child, events=str(tmp_path / "e.jsonl"))
+    ckdir = tmp_path / "runs" / "SYNTH_MNIST"
+    os.makedirs(ckdir)
+    np.savez(ckdir / "checkpoint.npz", weights=np.zeros(3))  # a stranger's
+    assert "--resume" not in s.build_cmd(attempt=1)
+    assert "--resume" in s.build_cmd(attempt=2)
+    # A prior manifest for THIS run-id makes even attempt 1 resume (the
+    # supervisor itself was restarted mid-run).
+    os.makedirs(tmp_path / "runs" / s.run_id, exist_ok=True)
+    with open(tmp_path / "runs" / s.run_id / "manifest.json", "w") as f:
+        json.dump({"status": "preempted"}, f)
+    assert "--resume" in s.build_cmd(attempt=1)
+    # Journal flags are always pinned.
+    cmd = s.build_cmd(attempt=1)
+    assert "--journal" in cmd and "--run-id" in cmd
+
+
+def test_supervisor_emits_valid_v3_events(tmp_path):
+    from attacking_federate_learning_tpu.utils.metrics import iter_events
+
+    sup = _load("supervisor")
+    s = _sup(sup, CHILD, events=str(tmp_path / "e.jsonl"))
+    s.emit("supervise_start", max_retries=3)
+    s.emit("degrade", failure="oom", step="batch_halved_to_16")
+    events = list(iter_events(str(tmp_path / "e.jsonl")))
+    assert [e["phase"] for e in events] == ["supervise_start", "degrade"]
+    assert all(e["v"] == 3 for e in events)
+
+
+def test_event_age_heartbeat_aware(tmp_path):
+    """Stall detection must read the last heartbeat's REAL-event age —
+    the heartbeat keeps the file mtime fresh precisely while stalled,
+    so mtime alone would mask the stall it exists to expose."""
+    import time
+
+    sup = _load("supervisor")
+    s = _sup(sup, CHILD, events=str(tmp_path / "e.jsonl"))
+    p = str(tmp_path / "run.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps({"kind": "round", "round": 3, "v": 1}) + "\n")
+        f.write(json.dumps({"kind": "heartbeat", "rss_mb": 1.0,
+                            "last_event_age_s": 612.5, "v": 2}) + "\n")
+    assert s._event_age(p, time.time()) == 612.5
+    # Real event last: fall back to file mtime (fresh file, tiny age).
+    with open(p, "a") as f:
+        f.write(json.dumps({"kind": "round", "round": 4, "v": 1}) + "\n")
+    assert s._event_age(p, time.time()) < 5.0
+    # Missing file: age since child start.
+    assert s._event_age(str(tmp_path / "nope.jsonl"),
+                        time.time() - 42.0) >= 42.0
+
+
+def test_raw_mode_passthrough(tmp_path):
+    sup = _load("supervisor")
+    s = _sup(sup, ["echo", "hi"], raw=True,
+             events=str(tmp_path / "e.jsonl"))
+    assert s.build_cmd(attempt=1) == ["echo", "hi"]
+    assert s.degrade_for("oom") is None
+
+
+def test_main_requires_child_args():
+    sup = _load("supervisor")
+    with pytest.raises(SystemExit):
+        sup.main(["--max-retries", "2"])
+
+
+# ---------------------------------------------------------------------------
+# end to end: one crash-matrix cell through real subprocesses (the full
+# matrix runs in tools/smoke.sh; this pins the CI-visible contract)
+
+def test_crash_matrix_single_cell(tmp_path):
+    cm = _load("crash_matrix")
+    rc = cm.main(["--modes", "fused", "--defenses", "Krum",
+                  "--epochs", "6", "--workdir", str(tmp_path)])
+    assert rc == 0
+    # The audited artifacts exist where the matrix says they do.
+    run_dir = tmp_path / "fused_Krum" / "runs"
+    from attacking_federate_learning_tpu.utils.lifecycle import RunJournal
+    j = RunJournal(str(run_dir), "crash_fused_Krum")
+    assert j.verify(epochs=6, test_step=5) == []
+    assert j.read_manifest()["status"] == "done"

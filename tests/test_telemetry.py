@@ -278,10 +278,16 @@ def test_schema_roundtrip_every_engine_kind(tmp_path):
     cfg4 = _tele_cfg(tmp_path, defense="NoDefense", epochs=2, test_step=2)
     ds4 = load_dataset(cfg4.dataset, seed=0, synth_train=256, synth_test=64)
     exp4 = FederatedExperiment(cfg4, attacker=DriftAttack(1.0), dataset=ds4)
+    from attacking_federate_learning_tpu.utils.lifecycle import RunJournal
+
     with RunLogger(cfg4, None, str(tmp_path),
                    jsonl_name="roundtrip4") as logger:
         exp4.cost_report(logger)
         logger.record(**logger.heartbeat_fields())
+        # v3: a journaled run emits the 'lifecycle' kind from the
+        # engine itself (start/complete; utils/lifecycle.py).
+        exp4.run(logger,
+                 journal=RunJournal(str(tmp_path / "runs"), "roundtrip4"))
         path4 = logger.jsonl_path
     with open(path4) as f:
         ev4 = [json.loads(line) for line in f]

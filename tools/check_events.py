@@ -7,11 +7,14 @@ validate_event) so a malformed emitter is caught by CI, not by a reader
 weeks later.  No device work (validation is pure Python over parsed
 JSON), so it runs in tier-1 time budget on any backend state.
 
-Speaks every supported schema version (v1 plus v2's compile/cost/
-heartbeat kinds).  An event stamped with a version this reader does not
-know is reported as "produced by a newer writer" — a clear per-line
-error, never a KeyError — and a v2-only kind stamped v1 is flagged as
-an emitter bug (utils/metrics.py:validate_event owns both rules).
+Speaks every supported schema version (v1, plus v2's compile/cost/
+heartbeat kinds, plus v3's lifecycle kind — the preempt/resume/retry/
+degrade transitions of utils/lifecycle.py).  An event stamped with a
+version this reader does not know is reported as "produced by a newer
+writer" — a clear per-line error, never a KeyError — and a newer-only
+kind stamped with an older version is flagged as an emitter bug
+(utils/metrics.py:validate_event owns both rules via
+KIND_MIN_VERSION).
 
 Usage:
     python tools/check_events.py logs/*.jsonl

@@ -1,20 +1,25 @@
 #!/usr/bin/env bash
-# One-liner CI smoke: event-schema validation + fault matrix + perf gate.
+# One-liner CI smoke: event-schema validation + fault matrix + crash
+# matrix + perf gate.
 #
-#   bash tools/smoke.sh            # all three, CPU-pinned
-#   bash tools/smoke.sh --fast     # skip the fault matrix (slowest leg)
+#   bash tools/smoke.sh            # all four, CPU-pinned
+#   bash tools/smoke.sh --fast     # skip the fault + crash matrices
+#                                  # (the two slowest legs)
 #
 # Legs (each independently CI-wired through tests/ as well):
-#   1. tools/check_events.py over every run JSONL in logs/ (schema v1+v2:
-#      round/eval/.../fault plus compile/cost/heartbeat) — skipped when
-#      logs/ has no .jsonl yet;
+#   1. tools/check_events.py over every run JSONL in logs/ (schema
+#      v1-v3: round/eval/.../fault, compile/cost/heartbeat, lifecycle)
+#      — skipped when logs/ has no .jsonl yet;
 #   2. tools/fault_matrix.py — 5-round fault x defense sweep, emitted
 #      'fault' events diffed against the host replay of the schedule;
-#   3. tools/perf_gate.py — deterministic static-HLO perf gate against
+#   3. tools/crash_matrix.py — supervised preempt/resume at a seeded
+#      round x {fused, staged, faulted} x 2 defenses: bounded retries,
+#      exactly-once journal, clean exit (tools/supervisor.py);
+#   4. tools/perf_gate.py — deterministic static-HLO perf gate against
 #      PERF_BASELINE.json (FLOPs/bytes exact, memory within tolerance).
 #
 # Exit: nonzero if any leg fails.  Always CPU (the gate's baseline is a
-# CPU artifact, and the fault matrix must not touch a TPU capture).
+# CPU artifact, and the matrices must not touch a TPU capture).
 set -u
 cd "$(dirname "$0")/.."
 
@@ -26,20 +31,23 @@ fail=0
 shopt -s nullglob
 jsonls=(logs/*.jsonl)
 if [ ${#jsonls[@]} -gt 0 ]; then
-    echo "== smoke 1/3: check_events (${#jsonls[@]} logs) =="
+    echo "== smoke 1/4: check_events (${#jsonls[@]} logs) =="
     python tools/check_events.py "${jsonls[@]}" || fail=1
 else
-    echo "== smoke 1/3: check_events — no logs/*.jsonl yet, skipped =="
+    echo "== smoke 1/4: check_events — no logs/*.jsonl yet, skipped =="
 fi
 
 if [ "${1:-}" != "--fast" ]; then
-    echo "== smoke 2/3: fault_matrix =="
+    echo "== smoke 2/4: fault_matrix =="
     python tools/fault_matrix.py || fail=1
+    echo "== smoke 3/4: crash_matrix (supervised preempt/resume) =="
+    python tools/crash_matrix.py || fail=1
 else
-    echo "== smoke 2/3: fault_matrix — skipped (--fast) =="
+    echo "== smoke 2/4: fault_matrix — skipped (--fast) =="
+    echo "== smoke 3/4: crash_matrix — skipped (--fast) =="
 fi
 
-echo "== smoke 3/3: perf_gate =="
+echo "== smoke 4/4: perf_gate =="
 python tools/perf_gate.py || fail=1
 
 if [ $fail -ne 0 ]; then

@@ -12,7 +12,13 @@
 #             scale, cell-5 skipped (it has its own dedicated overnight
 #             job).  Proves the mechanics + prints the same [budget]
 #             lines the real window will, so the per-step ordering is
-#             provably sane before a window opens.
+#             provably sane before a window opens.  Also runs one
+#             injected preempt->resume lifecycle drill (step 0) through
+#             tools/supervisor.py, exactly-once journal audited.
+#
+# Every step runs under tools/supervisor.py (--raw): a crash mid-step
+# retries inside the SAME relay window instead of losing it; the
+# supervisor's v3 lifecycle events land next to the step logs.
 #
 # Every step prints "[budget] <step>: <s>s (cum <s>s)" — in a real
 # window this is the record of where the window went; the rehearsal's
@@ -92,10 +98,34 @@ trap 'rm -rf "$LOCK" 2>/dev/null' EXIT
 
 if ! probe; then echo "relay dead; aborting" >&2; exit 1; fi
 
+# Every capture step runs under the supervisor (tools/supervisor.py,
+# --raw: retry/backoff only): a crash mid-step retries INSIDE the same
+# relay window instead of wasting it.  Supervisor chatter goes to
+# stderr (stdout artifacts like bench JSON stay clean); its lifecycle
+# events land in $OUT/supervisor_$STAMP.jsonl (schema v3).
+SUP=(python tools/supervisor.py --raw --max-retries 1 --backoff-base 5
+     --events "$OUT/supervisor_$STAMP.jsonl" --)
+
+if [ "$REHEARSE" = 1 ]; then
+  echo "== step 0: lifecycle drill (injected preempt -> resume) =="
+  # One supervised preempt/resume cycle through the real machinery:
+  # FL_PREEMPT_AT_ROUND fires at a span boundary, the child exits 75
+  # with a checkpoint, the supervisor resumes it, and the journal must
+  # audit exactly-once.  A failing drill aborts the rehearsal — the
+  # mechanics it proves are exactly what a real window relies on.
+  DRILL="$OUT/drill_$STAMP"
+  python tools/supervisor.py --inject-preempt-round 2 --verify-journal \
+    --checkpoint-every 2 --events "$OUT/supervisor_$STAMP.jsonl" -- \
+    --backend cpu -s SYNTH_MNIST -e 5 -c 16 --synth-train 256 \
+    --synth-test 64 --run-dir "$DRILL/runs" --log-dir "$DRILL/logs" \
+    || { echo "lifecycle drill FAILED" >&2; exit 1; }
+  budget "step0-drill"
+fi
+
 echo "== step 1: bench.py (headline + 10k north star + per-impl) =="
 # Outer bound must exceed bench's internal 5700 s final deadline so the
 # clean banked-results exit (not this SIGTERM) is what ends a slow run.
-timeout 6000 python bench.py >"$OUT/bench_$STAMP.json" \
+"${SUP[@]}" timeout 6000 python bench.py >"$OUT/bench_$STAMP.json" \
   2>"$OUT/bench_$STAMP.log"
 echo "bench rc=$? json:"; cat "$OUT/bench_$STAMP.json"
 tail -30 "$OUT/bench_$STAMP.log"
@@ -106,7 +136,7 @@ budget "step1-bench"
 probe || { echo "relay died after bench" >&2; exit 1; }
 echo "== step 2: TPU-backend test re-run (fused backdoor, Mosaic pallas,"
 echo "   engine, defense kernels incl. the hybrid Bulyan callback) =="
-${STEP2_ENV[@]+"${STEP2_ENV[@]}"} timeout 3600 python -m pytest \
+"${SUP[@]}" ${STEP2_ENV[@]+"${STEP2_ENV[@]}"} timeout 3600 python -m pytest \
   tests/test_pallas.py tests/test_engine.py tests/test_parallel.py \
   tests/test_defenses.py \
   -q --no-header 2>&1 | tee "$OUT/pytest_tpu_$STAMP.log" | tail -15
@@ -114,7 +144,7 @@ budget "step2-pytest"
 
 probe || { echo "relay died after pytest" >&2; exit 1; }
 echo "== step 3: BASELINE cells =="
-timeout 7200 python -m attacking_federate_learning_tpu.benchmarks \
+"${SUP[@]}" timeout 7200 python -m attacking_federate_learning_tpu.benchmarks \
   --rounds 10 ${STEP3_CELLS[@]+"${STEP3_CELLS[@]}"} 2>&1 \
   | tee "$OUT/cells_$STAMP.log" | grep -E '^\{' || true
 budget "step3-cells"
@@ -127,7 +157,7 @@ fi
 
 probe || { echo "relay died after cells 1-4" >&2; exit 1; }
 echo "== step 4: 10k non-IID grid (cell 5, overnight north star) =="
-timeout 14400 python -m attacking_federate_learning_tpu.benchmarks \
+"${SUP[@]}" timeout 14400 python -m attacking_federate_learning_tpu.benchmarks \
   --rounds 10 --cells 5 2>&1 \
   | tee "$OUT/cell5_$STAMP.log" | grep -E '^\{' || true
 budget "step4-cell5"
