@@ -2,7 +2,7 @@ from attacking_federate_learning_tpu.attacks.base import (  # noqa: F401
     Attack, AttackContext, NoAttack, cohort_stats
 )
 from attacking_federate_learning_tpu.attacks.alie import DriftAttack  # noqa: F401
-from attacking_federate_learning_tpu.utils.registry import Registry
+from attacking_federate_learning_tpu.utils.plugins import Registry
 
 # Factories with the uniform signature (cfg, dataset) -> Attack, so new
 # attacks plug in the way new defenses do (the reference hardwires its two

@@ -405,6 +405,16 @@ def main(argv=None):
         from attacking_federate_learning_tpu.report import main as report_main
 
         return report_main(argv[1:])
+    if argv and argv[0] == "runs":
+        # Cross-run registry subcommand (runs_cli.py): list/show/diff/
+        # compare/tag/trace/selfcheck over runs/index.jsonl
+        # (utils/registry.py).  Pure log/JSON reading, no jax; same
+        # pre-argparse dispatch as 'report'.
+        from attacking_federate_learning_tpu.runs_cli import (
+            main as runs_main
+        )
+
+        return runs_main(argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.attack == "backdoor" and args.backdoor == "No":
@@ -437,9 +447,18 @@ def main(argv=None):
         PhaseTimer, xla_trace
     )
 
+    # A journaled run gets a PRIVATE event log named by its run id: the
+    # reference CSV filename schema (config.csv_name) encodes no seed,
+    # so two runs differing only by seed would interleave into one
+    # JSONL — unusable for the registry's per-run rollups and 'runs
+    # diff' trajectory comparison.  Unjournaled runs keep the
+    # reference-schema name.
+    run_id = (args.run_id or run_id_for(cfg)
+              if (args.journal or args.run_id) else None)
+
     # Context-managed: the JSONL handle is closed and the accuracy CSV
     # written even when the run raises (utils/metrics.py:RunLogger).
-    with RunLogger(cfg, cfg.output, cfg.log_dir,
+    with RunLogger(cfg, cfg.output, cfg.log_dir, jsonl_name=run_id,
                    heartbeat_every=args.heartbeat) as logger:
         logger.dump_config()
 
@@ -450,11 +469,23 @@ def main(argv=None):
                                  name=None if args.attack == "auto"
                                  else args.attack)
         exp = FederatedExperiment(cfg, attacker=attacker, dataset=dataset)
-        checkpointer = None if args.no_checkpoint else Checkpointer(cfg)
+        # Run-lifecycle journal (utils/lifecycle.py), created BEFORE the
+        # checkpointer: a journaled run's rotated auto-checkpoints live
+        # under its own runs/<run_id>/ (PR 5 layout — the shared
+        # runs/<dataset>/ dir made two runs' resume points collide),
+        # so the Checkpointer needs the journal dir.
+        journal = None
+        if run_id is not None:
+            journal = RunJournal(cfg.run_dir, run_id)
+            logger.print(f"[lifecycle] journal {journal.dir} "
+                         f"(attempts so far: {journal.attempt})")
+        auto_dir = journal.dir if journal is not None else None
+        checkpointer = (None if args.no_checkpoint
+                        else Checkpointer(cfg, auto_dir=auto_dir))
         if args.resume is not None:
             import numpy as np
 
-            ckpt = checkpointer or Checkpointer(cfg)
+            ckpt = checkpointer or Checkpointer(cfg, auto_dir=auto_dir)
             # 'auto' resumes from the newest checkpoint by round —
             # rotated auto-checkpoints compete with the best-accuracy
             # one, so a killed run continues from where it actually got.
@@ -507,19 +538,11 @@ def main(argv=None):
             for name, msg in ledger.errors:
                 logger.print(f"[cost] {name}: analysis failed: {msg}")
         timer = PhaseTimer() if args.profile else None
-        # Run-lifecycle layer (utils/lifecycle.py): the journal is
-        # opt-in (--journal / --run-id); graceful SIGTERM/SIGINT
-        # handling is always on for a CLI-driven run — a signal lands
-        # as a checkpoint + 'preempted' exit (75) at the next span
-        # boundary instead of a lost run.  FL_PREEMPT_AT_ROUND is the
-        # deterministic injection seam (tests, tools/crash_matrix.py,
-        # the capture rehearsal drill).
-        journal = None
-        if args.journal or args.run_id:
-            journal = RunJournal(cfg.run_dir,
-                                 args.run_id or run_id_for(cfg))
-            logger.print(f"[lifecycle] journal {journal.dir} "
-                         f"(attempts so far: {journal.attempt})")
+        # Graceful SIGTERM/SIGINT handling is always on for a CLI-driven
+        # run — a signal lands as a checkpoint + 'preempted' exit (75)
+        # at the next span boundary instead of a lost run.
+        # FL_PREEMPT_AT_ROUND is the deterministic injection seam
+        # (tests, tools/crash_matrix.py, the capture rehearsal drill).
         pre_at = os.environ.get("FL_PREEMPT_AT_ROUND")
         shutdown = GracefulShutdown(
             preempt_at_round=int(pre_at) if pre_at else None)

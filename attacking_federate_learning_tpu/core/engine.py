@@ -33,6 +33,7 @@ land in the run JSONL as 'defense'/'attack' records plus one end-of-run
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -1221,7 +1222,9 @@ class FederatedExperiment:
             EXIT_PREEMPTED, Preempted
         )
 
-        ck = checkpointer or Checkpointer(self.cfg)
+        ck = checkpointer or Checkpointer(
+            self.cfg,
+            auto_dir=journal.dir if journal is not None else None)
         path = ck.save_auto(self.state, extra=self.fault_state_host())
         source = shutdown.source or "signal"
         logger.record(kind="lifecycle", phase="preempt", round=int(epoch),
@@ -1265,6 +1268,7 @@ class FederatedExperiment:
                                self.fault_state_host())
         epoch = int(self.state.round)
         start_epoch = epoch
+        last_asr = None
         if journal is not None:
             attempt = journal.start_attempt(epoch)
             phase_name = ("start" if attempt == 1 and epoch == 0
@@ -1367,8 +1371,9 @@ class FederatedExperiment:
                     # accuracy line as in the reference (main.py:91-95).
                     asr = self.attacker.test_asr(self.state.weights,
                                                  logger=logger, tag="POST")
+                    last_asr = float(asr)
                     logger.record(kind="asr", round=epoch,
-                                  attack_success_rate=float(asr))
+                                  attack_success_rate=last_asr)
                 if journal is not None:
                     journal.commit_eval(epoch)
             if ckpt_every and epoch % ckpt_every == 0:
@@ -1404,8 +1409,44 @@ class FederatedExperiment:
             logger.record(kind="lifecycle", phase="complete",
                           round=int(self.state.round) - 1,
                           attempt=journal.attempt)
-            journal.finish("done")
+            # Registry stamp (PR 5, utils/registry.py): the manifest
+            # becomes the run's queryable summary — trajectory
+            # endpoints, the event-log join path, and the full config
+            # (what 'runs diff' reads for config deltas) — and one
+            # index line is appended so the finished run is resolvable
+            # without a rescan.  A v4 'registry' event mirrors the
+            # stamp into the event log itself.
+            import dataclasses as _dc
+
+            from attacking_federate_learning_tpu.utils.lifecycle import (
+                run_id_for
+            )
+            from attacking_federate_learning_tpu.utils.registry import (
+                RunRegistry
+            )
+
+            summary = {"events": os.path.abspath(logger.jsonl_path)}
+            if logger.accuracies:
+                summary["final_accuracy"] = round(
+                    float(logger.accuracies[-1]), 4)
+                summary["max_accuracy"] = round(
+                    float(max(logger.accuracies)), 4)
+            if last_asr is not None:
+                summary["final_asr"] = round(last_asr, 4)
+            logger.record(kind="registry", run_id=journal.run_id,
+                          rounds=int(self.state.round), **summary)
+            journal.finish("done",
+                           config=_dc.asdict(cfg),
+                           config_hash=run_id_for(cfg).rsplit("_", 1)[-1],
+                           **summary)
             journal.close()
+            try:
+                reg = RunRegistry(cfg.run_dir)
+                reg.stamp(reg._entry_for_run(journal.run_id,
+                                             migrate=False))
+            except OSError as e:       # an unwritable index must not
+                logger.print(f"[registry] stamp failed: {e}")  # fail a
+                #                                           finished run
         logger.finish()
         return {"accuracies": logger.accuracies,
                 "epochs": logger.accuracies_epochs,

@@ -50,7 +50,7 @@ import numpy as np
 from jax import lax
 
 from attacking_federate_learning_tpu.ops.distances import pairwise_distances
-from attacking_federate_learning_tpu.utils.registry import Registry
+from attacking_federate_learning_tpu.utils.plugins import Registry
 
 
 DEFENSES = Registry("defense")

@@ -44,6 +44,7 @@ def run_grid(base: ExperimentConfig, defenses=None, attacks=None,
         FederatedExperiment
     )
     from attacking_federate_learning_tpu.data.datasets import load_dataset
+    from attacking_federate_learning_tpu.utils.lifecycle import run_id_for
     from attacking_federate_learning_tpu.utils.metrics import RunLogger
 
     defenses = defenses or _all_defenses()
@@ -69,13 +70,17 @@ def run_grid(base: ExperimentConfig, defenses=None, attacks=None,
             backdoor="pattern" if attack == "backdoor" else False,
             num_std=0.0 if attack == "none" else base.num_std,
             mal_prop=0.0 if attack == "none" else base.mal_prop)
+        # Config-hash identity (utils/lifecycle.py): the join key
+        # between a GRID row and the run registry (runs/index.jsonl).
+        run_id = run_id_for(cfg)
         try:
             attacker = make_attacker(cfg, dataset=dataset,
                                      name=attack)
             exp = FederatedExperiment(cfg, attacker=attacker,
                                       dataset=dataset)
         except ValueError as e:  # defense guard (n vs f) — record & skip
-            emit({"defense": defense, "attack": attack, "skipped": str(e)})
+            emit({"defense": defense, "attack": attack,
+                  "run_id": run_id, "skipped": str(e)})
             continue
         t0 = time.time()
         try:
@@ -85,11 +90,12 @@ def run_grid(base: ExperimentConfig, defenses=None, attacks=None,
                            jsonl_name=f"grid_{defense}_{attack}") as logger:
                 out = exp.run(logger)
         except FloatingPointError as e:  # backdoor nan guard — record cell
-            emit({"defense": defense, "attack": attack, "failed": str(e),
+            emit({"defense": defense, "attack": attack,
+                  "run_id": run_id, "failed": str(e),
                   "wall_s": round(time.time() - t0, 2)})
             continue
         cell = {
-            "defense": defense, "attack": attack,
+            "defense": defense, "attack": attack, "run_id": run_id,
             "final_accuracy": out["accuracies"][-1],
             "max_accuracy": max(out["accuracies"]),
             "rounds": cfg.epochs,

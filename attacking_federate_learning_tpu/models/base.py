@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from typing import Callable, NamedTuple, Tuple
 
-from attacking_federate_learning_tpu.utils.registry import Registry
+from attacking_federate_learning_tpu.utils.plugins import Registry
 
 
 class Model(NamedTuple):

@@ -27,16 +27,23 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 from collections import Counter
 
 from attacking_federate_learning_tpu.utils.metrics import iter_events
 
 
-def load_events(paths, validate: bool = True) -> list:
-    """All events from the given JSONLs, schema-validated by default."""
+def load_events(paths, validate: bool = True, skip_bad: bool = False,
+                bad_lines: list = None) -> list:
+    """All events from the given JSONLs, schema-validated by default.
+    ``skip_bad`` tolerates torn/invalid lines (counted into
+    ``bad_lines`` as (lineno, msg)) — mixed-version and crash-truncated
+    logs summarize instead of aborting the whole invocation."""
     events = []
     for p in paths:
-        events.extend(iter_events(p, validate=validate))
+        events.extend(iter_events(p, validate=validate,
+                                  skip_bad=skip_bad,
+                                  bad_lines=bad_lines))
     return events
 
 
@@ -244,6 +251,8 @@ def summarize_run(events):
 def _print_run(path, s, out):
     out(f"== {path} ==")
     head = [f"{s['events']} events"]
+    if s.get("bad_lines"):
+        head.append(f"{s['bad_lines']} torn/invalid line(s) skipped")
     if "defense" in s:
         head.append(f"defense={s['defense']}")
     if "attack" in s:
@@ -333,19 +342,50 @@ def main(argv=None) -> int:
         description="Summarize structured run JSONLs: selection "
                     "concentration, phase timing, accuracy/ASR "
                     "trajectories (utils/metrics.py event schema).")
-    p.add_argument("paths", nargs="+", metavar="RUN_JSONL")
+    p.add_argument("paths", nargs="*", metavar="RUN_JSONL")
     p.add_argument("--json", action="store_true",
                    help="machine-readable output (one object keyed by "
                         "path)")
     p.add_argument("--no-validate", action="store_true",
                    help="skip schema validation (reading logs from a "
                         "newer/older writer)")
+    p.add_argument("--skip-bad", action="store_true",
+                   help="tolerate torn/invalid lines (crash-truncated "
+                        "logs): skip them with a per-file count instead "
+                        "of aborting")
+    p.add_argument("--run-id", action="append", default=[],
+                   metavar="QUERY",
+                   help="resolve a run through the cross-run registry "
+                        "(runs/index.jsonl — exact id, unique prefix "
+                        "or tag) and report its event log; repeatable, "
+                        "mixes with explicit paths")
+    p.add_argument("--run-dir", default="runs",
+                   help="registry location for --run-id resolution")
     args = p.parse_args(argv)
 
+    paths = list(args.paths)
+    for query in args.run_id:
+        from attacking_federate_learning_tpu.utils.registry import (
+            RunRegistry
+        )
+
+        entry = RunRegistry(args.run_dir).resolve(query)
+        events = entry.get("events")
+        if not isinstance(events, str) or not os.path.exists(events):
+            p.error(f"--run-id {query}: run {entry['run_id']} has no "
+                    f"readable event log (events={events!r})")
+        paths.append(events)
+    if not paths:
+        p.error("nothing to report: give RUN_JSONL paths and/or --run-id")
+
     runs = {}
-    for path in args.paths:
+    for path in paths:
+        bad: list = []
         runs[path] = summarize_run(
-            load_events([path], validate=not args.no_validate))
+            load_events([path], validate=not args.no_validate,
+                        skip_bad=args.skip_bad, bad_lines=bad))
+        if bad:
+            runs[path]["bad_lines"] = len(bad)
 
     if args.json:
         print(json.dumps(runs))

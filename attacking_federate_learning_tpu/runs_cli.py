@@ -1,0 +1,361 @@
+"""The ``runs`` subcommand: query and compare the cross-run registry.
+
+``cli.py`` dispatches ``... cli runs <verb>`` here (before argparse, so
+the experiment flag surface stays reference-verbatim).  Verbs:
+
+- ``runs list``     — refresh + print the index (``key=value`` filters)
+- ``runs show Q``   — one resolved run's full entry + journal audit
+- ``runs diff A B`` — field-by-field diff of two runs: config deltas,
+  final accuracy/ASR, fault/lifecycle/cache counts, and the per-round
+  trajectory divergence point (bit-identity when the shared rounds
+  match exactly — the determinism witness two same-seed runs must pass)
+- ``runs compare Q...`` — side-by-side metric table over N runs
+- ``runs tag Q TAG``    — attach a resolvable human tag
+- ``runs trace Q``      — export the run's event log as Chrome/Perfetto
+  trace JSON (utils/trace_export.py)
+- ``runs selfcheck``    — CI leg: refresh idempotence + resolvability
+  over the current run store (tools/smoke.sh leg 6)
+
+Resolution (utils/registry.py): exact run_id, unique prefix, tag, with
+``key=value`` filters narrowing first.  Pure log/JSON reading — no jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from attacking_federate_learning_tpu.utils.metrics import iter_events
+from attacking_federate_learning_tpu.utils.registry import RunRegistry
+
+
+# Entry fields shown by `runs list` / `runs compare`.
+_LIST_FIELDS = ("status", "dataset", "defense", "seed", "rounds_committed",
+                "final_accuracy", "final_asr", "tag")
+_COMPARE_FIELDS = ("source", "status", "attempts", "rounds_committed",
+                   "evals_committed", "final_accuracy", "max_accuracy",
+                   "final_asr", "cache_hits", "fault_rounds", "torn_lines")
+
+# Per-round event kinds whose payloads witness the trajectory; 't'
+# (wall clock) and 'v' (schema stamp) are not trajectory.
+_TRAJ_KINDS = ("round", "eval", "asr", "defense", "attack", "fault")
+_NON_TRAJ_FIELDS = {"t", "v"}
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.2f}"
+    return str(v)
+
+
+def _load_run_events(entry):
+    """The run's event stream (torn-tolerant), or [] when the entry has
+    no readable log."""
+    path = entry.get("events")
+    if not isinstance(path, str) or not os.path.exists(path):
+        return []
+    return list(iter_events(path, validate=False, skip_bad=True))
+
+
+def _trajectory(events):
+    """{round: {kind: payload}} over the per-round kinds — the
+    comparable fingerprint of one run's behavior."""
+    out = {}
+    for e in events:
+        kind = e.get("kind")
+        r = e.get("round")
+        if kind not in _TRAJ_KINDS or not isinstance(r, (int, float)):
+            continue
+        payload = {k: v for k, v in e.items()
+                   if k not in _NON_TRAJ_FIELDS}
+        out.setdefault(int(r), {})[kind] = payload
+    return out
+
+
+def diff_trajectories(events_a, events_b) -> dict:
+    """First-divergence analysis over two runs' per-round records.
+
+    Compares the payloads of every shared (round, kind) pair in round
+    order; the first mismatch names the round, the kind and the fields
+    that differ.  ``bit_identical`` is True when every shared pair
+    matches exactly — floats included, which is the right bar: the
+    engine is deterministic, so two same-seed runs must reproduce to
+    the bit and any ulp wiggle is a real (if legal) program change."""
+    ta, tb = _trajectory(events_a), _trajectory(events_b)
+    shared = sorted(set(ta) & set(tb))
+    out = {"rounds_a": len(ta), "rounds_b": len(tb),
+           "rounds_compared": len(shared),
+           "divergence_round": None, "bit_identical": False}
+    for r in shared:
+        kinds = sorted(set(ta[r]) & set(tb[r]))
+        for kind in kinds:
+            pa, pb = ta[r][kind], tb[r][kind]
+            bad = sorted(k for k in set(pa) | set(pb)
+                         if pa.get(k) != pb.get(k))
+            if bad:
+                out["divergence_round"] = r
+                out["divergence_kind"] = kind
+                out["divergence_fields"] = {
+                    k: [pa.get(k), pb.get(k)] for k in bad[:5]}
+                return out
+    out["bit_identical"] = bool(shared)
+    return out
+
+
+def diff_runs(reg: RunRegistry, ea: dict, eb: dict) -> dict:
+    """Field-by-field run diff: config deltas (from the stamped
+    manifests), summary-field deltas, and the trajectory divergence
+    point from the two event logs."""
+    out = {"a": ea.get("run_id"), "b": eb.get("run_id")}
+    ca, cb = reg.load_config(ea), reg.load_config(eb)
+    if ca is not None and cb is not None:
+        out["config_deltas"] = {
+            k: [ca.get(k), cb.get(k)]
+            for k in sorted(set(ca) | set(cb)) if ca.get(k) != cb.get(k)}
+    out["field_deltas"] = {
+        k: [ea.get(k), eb.get(k)]
+        for k in _COMPARE_FIELDS if ea.get(k) != eb.get(k)}
+    out["trajectory"] = diff_trajectories(_load_run_events(ea),
+                                          _load_run_events(eb))
+    return out
+
+
+def _print_diff(d, out=print):
+    out(f"== runs diff: {d['a']}  vs  {d['b']} ==")
+    cd = d.get("config_deltas")
+    if cd is None:
+        out("  config: no stamped configs (pre-registry manifests)")
+    elif not cd:
+        out("  config: identical")
+    else:
+        out(f"  config deltas ({len(cd)}):")
+        for k, (va, vb) in cd.items():
+            out(f"    {k}: {va!r} -> {vb!r}")
+    fd = d["field_deltas"]
+    if fd:
+        out("  summary deltas:")
+        for k, (va, vb) in fd.items():
+            out(f"    {k}: {_fmt(va)} vs {_fmt(vb)}")
+    else:
+        out("  summary: identical")
+    tr = d["trajectory"]
+    if not tr["rounds_compared"]:
+        out("  trajectory: no shared per-round records to compare")
+    elif tr["bit_identical"]:
+        out(f"  trajectory: BIT-IDENTICAL over {tr['rounds_compared']} "
+            f"shared rounds")
+    elif tr["divergence_round"] is not None:
+        fields = ", ".join(
+            f"{k} ({_fmt(v[0])} vs {_fmt(v[1])})"
+            for k, v in tr["divergence_fields"].items())
+        out(f"  trajectory: first divergence at round "
+            f"{tr['divergence_round']} in '{tr['divergence_kind']}' "
+            f"[{fields}]")
+
+
+def _refresh(reg, args):
+    summary = reg.refresh(bench=args.bench, progress=args.progress)
+    return summary
+
+
+def cmd_list(reg, args):
+    if not args.no_refresh:
+        s = _refresh(reg, args)
+        print(f"[registry] {s['entries']} entries "
+              f"({s['built']} rebuilt, {s['reused']} reused"
+              + (f", {s['migrated']} checkpoint(s) migrated"
+                 if s.get("migrated") else "") + ")")
+    ents = reg.entries(args.filter)
+    if args.json:
+        print(json.dumps(ents, default=str))
+        return 0
+    if not ents:
+        print("no runs in the index (run something with --journal, or "
+              "check --run-dir)")
+        return 0
+    for e in ents:
+        cols = "  ".join(f"{k}={_fmt(e.get(k))}" for k in _LIST_FIELDS
+                         if e.get(k) is not None)
+        print(f"{e['run_id']}  [{e.get('source', '?')}]  {cols}")
+    return 0
+
+
+def cmd_show(reg, args):
+    e = reg.resolve(args.query, args.filter)
+    if args.json:
+        print(json.dumps(e, default=str))
+        return 0
+    print(f"== {e['run_id']} ==")
+    for k in sorted(e):
+        if k in ("run_id", "sig"):
+            continue
+        print(f"  {k}: {e[k]}")
+    if e.get("source") == "run":
+        from attacking_federate_learning_tpu.utils.lifecycle import (
+            RunJournal
+        )
+        j = RunJournal(os.path.dirname(e["dir"]), e["run_id"])
+        problems = j.verify()
+        j.close()
+        print("  journal audit: " + ("clean" if not problems
+                                     else "; ".join(problems)))
+    return 0
+
+
+def cmd_diff(reg, args):
+    d = diff_runs(reg, reg.resolve(args.a, args.filter),
+                  reg.resolve(args.b, args.filter))
+    if args.json:
+        print(json.dumps(d, default=str))
+    else:
+        _print_diff(d)
+    return 0
+
+
+def cmd_compare(reg, args):
+    ents = [reg.resolve(q, args.filter) for q in args.queries]
+    if args.json:
+        print(json.dumps(ents, default=str))
+        return 0
+    width = max(len(str(e["run_id"])) for e in ents)
+    header = f"{'run_id':<{width}}  " + "  ".join(
+        f"{k:>14s}" for k in _COMPARE_FIELDS)
+    print(header)
+    for e in ents:
+        print(f"{e['run_id']:<{width}}  " + "  ".join(
+            f"{_fmt(e.get(k)):>14s}" for k in _COMPARE_FIELDS))
+    return 0
+
+
+def cmd_tag(reg, args):
+    e = reg.tag(args.query, args.tag)
+    print(f"tagged {e['run_id']} as {args.tag!r}")
+    return 0
+
+
+def cmd_trace(reg, args):
+    from attacking_federate_learning_tpu.utils.trace_export import (
+        export_trace
+    )
+
+    e = reg.resolve(args.query, args.filter)
+    events = e.get("events")
+    if not isinstance(events, str) or not os.path.exists(events):
+        print(f"run {e['run_id']} has no readable event log "
+              f"(events={events!r})")
+        return 1
+    out = export_trace(events, args.out, name=e["run_id"])
+    print(f"wrote {out} (load in chrome://tracing or ui.perfetto.dev)")
+    return 0
+
+
+def cmd_selfcheck(reg, args):
+    """CI self-check (tools/smoke.sh leg 6): two refreshes must agree
+    (incremental refresh is idempotent over an unchanged store), every
+    run entry must resolve by its own id, and the index must survive
+    its own round trip."""
+    problems = []
+    s1 = _refresh(reg, args)
+    e1 = reg.entries()
+    s2 = _refresh(reg, args)
+    e2 = reg.entries()
+    if e1 != e2:
+        changed = [a.get("run_id") for a, b in zip(e1, e2) if a != b]
+        problems.append(f"refresh not idempotent (changed: {changed})")
+    if s2["built"] != 0:
+        problems.append(f"second refresh rebuilt {s2['built']} "
+                        f"entries over an unchanged store")
+    for e in e2:
+        try:
+            got = reg.resolve(str(e["run_id"]))
+            if got != e:
+                problems.append(f"{e['run_id']}: resolve returned a "
+                                f"different entry")
+        except ValueError as err:
+            problems.append(f"{e['run_id']}: unresolvable: {err}")
+    torn = [e["run_id"] for e in e2
+            if e.get("problems") or e.get("torn_lines")]
+    print(f"[selfcheck] {len(e2)} entries, {s1['built']} rebuilt on "
+          f"first refresh, 0 expected on second"
+          + (f"; tolerated torn artifacts in {torn}" if torn else ""))
+    if problems:
+        for p in problems:
+            print(f"FAIL selfcheck: {p}")
+        return 1
+    print("ok   selfcheck: index refresh idempotent, all entries "
+          "resolvable")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="attacking_federate_learning_tpu runs",
+        description="Query the cross-run registry (utils/registry.py: "
+                    "runs/index.jsonl over journal dirs + BENCH/"
+                    "PROGRESS artifacts).")
+    p.add_argument("--run-dir", default="runs",
+                   help="the run store to index (cfg.run_dir)")
+    p.add_argument("--bench", action="append", default=None,
+                   metavar="GLOB",
+                   help="bench JSON glob to ingest on refresh "
+                        "(repeatable; default BENCH_*.json; pass '' "
+                        "to disable)")
+    p.add_argument("--progress", action="append", default=None,
+                   metavar="GLOB",
+                   help="progress JSONL glob to ingest on refresh "
+                        "(repeatable; default PROGRESS.jsonl; pass '' "
+                        "to disable)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.add_argument("--filter", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="restrict to entries whose field matches "
+                        "(repeatable; e.g. --filter defense=Krum)")
+    sub = p.add_subparsers(dest="verb", required=True)
+    sp = sub.add_parser("list", help="refresh + list the index")
+    sp.add_argument("--no-refresh", action="store_true",
+                    help="read the existing index without rescanning")
+    sp.set_defaults(fn=cmd_list)
+    sp = sub.add_parser("show", help="one run's full entry")
+    sp.add_argument("query")
+    sp.set_defaults(fn=cmd_show)
+    sp = sub.add_parser("diff", help="field-by-field diff of two runs")
+    sp.add_argument("a")
+    sp.add_argument("b")
+    sp.set_defaults(fn=cmd_diff)
+    sp = sub.add_parser("compare", help="side-by-side metric table")
+    sp.add_argument("queries", nargs="+")
+    sp.set_defaults(fn=cmd_compare)
+    sp = sub.add_parser("tag", help="attach a resolvable tag")
+    sp.add_argument("query")
+    sp.add_argument("tag")
+    sp.set_defaults(fn=cmd_tag)
+    sp = sub.add_parser("trace", help="export Chrome/Perfetto trace JSON")
+    sp.add_argument("query")
+    sp.add_argument("-o", "--out", default=None)
+    sp.set_defaults(fn=cmd_trace)
+    sp = sub.add_parser("selfcheck",
+                        help="CI: refresh idempotence + resolvability")
+    sp.set_defaults(fn=cmd_selfcheck)
+    args = p.parse_args(argv)
+    if args.bench is None:
+        args.bench = ["BENCH_*.json"]
+    if args.progress is None:
+        args.progress = ["PROGRESS.jsonl"]
+
+    reg = RunRegistry(args.run_dir)
+    if args.verb != "list" and not os.path.exists(reg.index_path):
+        # Verbs that read the index build it on first use.
+        reg.refresh(bench=args.bench, progress=args.progress)
+    try:
+        return args.fn(reg, args)
+    except ValueError as e:
+        print(f"runs {args.verb}: {e}")
+        return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,4 +1,4 @@
 from attacking_federate_learning_tpu.utils.flatten import (  # noqa: F401
     FlatParams, make_flattener
 )
-from attacking_federate_learning_tpu.utils.registry import Registry  # noqa: F401
+from attacking_federate_learning_tpu.utils.plugins import Registry  # noqa: F401

@@ -40,16 +40,31 @@ class Checkpointer:
     ``extra``: a dict of named arrays saved alongside the server state
     — the engine checkpoints its fault-injection state (the straggler
     ring buffer) here so a resumed faulted run continues bit-for-bit.
+
+    ``auto_dir``: where the rotated auto-checkpoints live.  Default is
+    the best-checkpoint dir itself (the pre-PR-5 shared layout);
+    journaled runs pass their own ``runs/<run_id>/`` so two runs over
+    the same dataset can no longer adopt each other's resume points
+    (the collision PR 4's supervisor had to gate on run-id progress).
+    The best-accuracy ``checkpoint.npz`` stays in ``runs/<dataset>/``
+    — that path is reference behavior (server.py:42).  Back-compat
+    reader: when the private auto dir has no autos yet, ``latest()``
+    falls back to autos in the legacy shared dir (pre-migration
+    artifacts; the registry refresh migrates the manifest-referenced
+    one on first sight, utils/registry.py).
     """
 
     _AUTO_PREFIX = "checkpoint-auto-"
 
     def __init__(self, cfg, run_dir: Optional[str] = None,
-                 keep_best: bool = True, keep_last: int = 3):
+                 keep_best: bool = True, keep_last: int = 3,
+                 auto_dir: Optional[str] = None):
         # Directory schema mirrors the reference: runs/<dataset>/
         # (server.py:42).
         self.dir = run_dir or os.path.join(cfg.run_dir, cfg.dataset)
+        self.auto_dir = auto_dir or self.dir
         os.makedirs(self.dir, exist_ok=True)
+        os.makedirs(self.auto_dir, exist_ok=True)
         self.cfg = cfg
         self.keep_best = keep_best
         self.keep_last = max(1, int(keep_last))
@@ -78,7 +93,7 @@ class Checkpointer:
             # Don't let a later, worse state overwrite the best checkpoint
             # (the reference always overwrites, server.py:40-48).
             return self.path
-        path = (os.path.join(self.dir, f"checkpoint-{tag}.npz")
+        path = (os.path.join(self.auto_dir, f"checkpoint-{tag}.npz")
                 if tag else self.path)
         arrays = dict(weights=np.asarray(state.weights),
                       velocity=np.asarray(state.velocity),
@@ -107,9 +122,24 @@ class Checkpointer:
         return path
 
     def _auto_paths(self) -> list:
-        names = sorted(n for n in os.listdir(self.dir)
+        names = sorted(n for n in os.listdir(self.auto_dir)
                        if n.startswith(self._AUTO_PREFIX)
                        and n.endswith(".npz"))
+        return [os.path.join(self.auto_dir, n) for n in names]
+
+    def _legacy_auto_paths(self) -> list:
+        """Autos still sitting in the shared legacy dir (pre-PR-5
+        layout, pre-migration) — resume candidates only when the
+        private auto dir has none, and never rotation victims (another
+        run may still own them)."""
+        if os.path.abspath(self.auto_dir) == os.path.abspath(self.dir):
+            return []
+        try:
+            names = sorted(n for n in os.listdir(self.dir)
+                           if n.startswith(self._AUTO_PREFIX)
+                           and n.endswith(".npz"))
+        except OSError:
+            return []
         return [os.path.join(self.dir, n) for n in names]
 
     def _rotate(self):
@@ -128,9 +158,9 @@ class Checkpointer:
         """Newest checkpoint by saved round — auto saves and the best
         save compete, so ``--resume`` (no path) continues from wherever
         the run actually got to."""
-        candidates = self._auto_paths()
+        candidates = self._auto_paths() or self._legacy_auto_paths()
         if os.path.exists(self.path):
-            candidates.append(self.path)
+            candidates = candidates + [self.path]
         best, best_round = None, -1
         for p in candidates:
             try:

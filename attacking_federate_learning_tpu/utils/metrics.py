@@ -26,9 +26,13 @@ FLOPs / bytes-accessed / memory facts, utils/costs.py) and
 ``heartbeat`` (the RunLogger liveness thread); v3 adds ``lifecycle``
 (run-lifecycle transitions — start/resume/preempt/complete from the
 engine, retry/degrade/exhausted from tools/supervisor.py;
-utils/lifecycle.py).  Readers accept every version; older logs simply
-never carry the newer kinds, and a newer-only kind stamped with an
-older version is an emitter bug, rejected (``KIND_MIN_VERSION``).
+utils/lifecycle.py); v4 adds the cross-run observatory rollups —
+``registry`` (the engine's run-finish stamp that joins the event log to
+``runs/index.jsonl``, utils/registry.py) and ``gate`` (one behavioral-
+drift verdict per pinned cell, tools/science_gate.py).  Readers accept
+every version; older logs simply never carry the newer kinds, and a
+newer-only kind stamped with an older version is an emitter bug,
+rejected (``KIND_MIN_VERSION``).
 """
 
 from __future__ import annotations
@@ -43,8 +47,8 @@ from typing import Optional
 import numpy as np
 
 
-SCHEMA_VERSION = 3
-SUPPORTED_VERSIONS = (1, 2, 3)
+SCHEMA_VERSION = 4
+SUPPORTED_VERSIONS = (1, 2, 3, 4)
 
 # kind -> required fields.  Producers: core/engine.py (round, eval, asr,
 # profile, stream, defense, attack, selection_hist via RunLogger).
@@ -90,13 +94,23 @@ EVENT_KINDS = {
     # (round, attempt, signal, failure class, degradation applied) ride
     # along as diagnostics.
     "lifecycle": {"phase"},
+    # --- v4: the cross-run observatory (utils/registry.py) -------------
+    # the engine's run-finish registry stamp: the run_id this event log
+    # belongs to, with the final-trajectory summary riding along
+    # (final/max accuracy, ASR, rounds) — the join key between a log
+    # and runs/index.jsonl
+    "registry": {"run_id"},
+    # one behavioral-drift gate verdict (tools/science_gate.py): the
+    # pinned cell's name and its pass/fail/skip status, with the
+    # compared metrics as extra fields
+    "gate": {"cell", "status"},
 }
 
 # Minimum schema version per kind introduced after v1; an event carrying
 # one of these but stamped with an older version is an emitter bug (an
 # older writer cannot know these kinds).
 KIND_MIN_VERSION = {"compile": 2, "cost": 2, "heartbeat": 2,
-                    "lifecycle": 3}
+                    "lifecycle": 3, "registry": 4, "gate": 4}
 
 # Back-compat alias (pre-v3 spelling used by external readers).
 V2_KINDS = {k for k, v in KIND_MIN_VERSION.items() if v == 2}
@@ -142,10 +156,14 @@ def validate_event(rec) -> dict:
     return rec
 
 
-def iter_events(path, validate: bool = True):
+def iter_events(path, validate: bool = True, skip_bad: bool = False,
+                bad_lines: Optional[list] = None):
     """Yield events from a run JSONL, optionally schema-validated.
     Raises ValueError (with the line number) on a malformed line so a
-    reader never silently consumes drifted events."""
+    reader never silently consumes drifted events — unless ``skip_bad``
+    (the cross-run readers: a crash-truncated log's torn tail must not
+    make the whole run store unreadable), in which case bad lines are
+    skipped and appended to ``bad_lines`` as (lineno, message)."""
     with open(path) as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
@@ -154,11 +172,19 @@ def iter_events(path, validate: bool = True):
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError as e:
+                if skip_bad:
+                    if bad_lines is not None:
+                        bad_lines.append((lineno, f"not JSON: {e}"))
+                    continue
                 raise ValueError(f"{path}:{lineno}: not JSON: {e}") from e
             if validate:
                 try:
                     validate_event(rec)
                 except ValueError as e:
+                    if skip_bad:
+                        if bad_lines is not None:
+                            bad_lines.append((lineno, str(e)))
+                        continue
                     raise ValueError(f"{path}:{lineno}: {e}") from e
             yield rec
 

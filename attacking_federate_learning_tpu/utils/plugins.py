@@ -1,0 +1,42 @@
+"""Tiny name->factory registry.
+
+The reference dispatches defenses through a module-level dict
+(reference defences.py:73-75); this generalizes that seam to defenses,
+attacks, models and partitioners so new plugins register by decorator.
+
+(Lived in utils/registry.py through PR 4; that module is now the
+cross-RUN registry — the queryable index over ``runs/`` — so the
+factory registry moved here.  Importers updated in place;
+``utils.Registry`` keeps re-exporting it.)
+"""
+
+from __future__ import annotations
+
+
+class Registry:
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._entries = {}
+
+    def register(self, name: str, obj=None):
+        if obj is None:  # decorator form
+            def deco(fn):
+                self._entries[name] = fn
+                return fn
+            return deco
+        self._entries[name] = obj
+        return obj
+
+    def __getitem__(self, name: str):
+        try:
+            return self._entries[name]
+        except KeyError:
+            raise KeyError(
+                f"Unknown {self.kind} {name!r}; available: {sorted(self._entries)}"
+            ) from None
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._entries
+
+    def names(self):
+        return sorted(self._entries)

@@ -1,0 +1,252 @@
+"""Render a structured run log as Chrome/Perfetto trace-event JSON.
+
+The event schema (utils/metrics.py) already carries everything a
+timeline viewer needs — per-round telemetry with relative timestamps,
+compile wall times, lifecycle transitions, fault injections, heartbeat
+liveness — but until PR 5 the only timeline view was ``tail -f``.  This
+module converts any run JSONL (all schema versions) into the Trace
+Event Format that ``chrome://tracing`` and https://ui.perfetto.dev load
+directly:
+
+- **rounds** become complete ("X") spans on one track: each round's
+  span opens at the earliest event carrying that round number and
+  closes at the next round's open (the last round closes at the last
+  event timestamp) — so the round cadence, eval stalls and fused-span
+  bursts are visible at a glance;
+- **compiles** become "X" spans of their measured ``compile_s`` on a
+  compile track (cache attribution in args);
+- **evals / asr / lifecycle / faults / stream / registry / gate**
+  become instant ("i") events with their payload in args;
+- **heartbeats** become counter ("C") tracks (rss_mb, rounds_per_s) —
+  a stalled run is a flat-lining counter;
+- the end-of-run **profile** summary (PhaseTimer) is laid out as
+  sequential "X" spans on a phases track (aggregates, not real
+  intervals — count/mean ride in args).
+
+``device_trace`` is the opt-in REAL capture hook: under ``FL_TEST_TPU=1``
+it wraps ``jax.profiler`` start/stop trace (XLA-level, TensorBoard/
+Perfetto-loadable) around a region; anywhere else it is a no-op, so
+harness code can always use it without risking a TPU touch on a box
+where the relay may be dead (CLAUDE.md).
+
+``validate_trace`` checks the exported object against the trace-event
+schema rules a viewer relies on (tests pin a real 5-round export).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from typing import Optional
+
+from attacking_federate_learning_tpu.utils.metrics import iter_events
+
+
+# Track (tid) layout inside the single "run" process.
+_TID_ROUNDS = 1
+_TID_EVALS = 2
+_TID_COMPILES = 3
+_TID_LIFECYCLE = 4
+_TID_FAULTS = 5
+_TID_PHASES = 6
+
+_TID_NAMES = {_TID_ROUNDS: "rounds", _TID_EVALS: "evals",
+              _TID_COMPILES: "compiles", _TID_LIFECYCLE: "lifecycle",
+              _TID_FAULTS: "faults", _TID_PHASES: "phases (aggregate)"}
+
+_INSTANT_KINDS = {"eval": _TID_EVALS, "asr": _TID_EVALS,
+                  "lifecycle": _TID_LIFECYCLE, "fault": _TID_FAULTS,
+                  "stream": _TID_LIFECYCLE, "registry": _TID_LIFECYCLE,
+                  "gate": _TID_LIFECYCLE}
+
+# Event-record fields that are bookkeeping, not payload.
+_META_FIELDS = {"kind", "t", "v"}
+
+
+def _us(t_seconds) -> int:
+    """Trace-event timestamps are integer microseconds."""
+    return int(round(1e6 * float(t_seconds)))
+
+
+def _args_of(rec) -> dict:
+    """JSON-safe payload args: scalars kept, vectors summarized by
+    length (a 79k-entry selection mask has no business in a tooltip)."""
+    out = {}
+    for k, v in rec.items():
+        if k in _META_FIELDS:
+            continue
+        if isinstance(v, (list, tuple)):
+            out[k] = f"<{len(v)} values>"
+        elif isinstance(v, (dict,)):
+            out[k] = f"<{len(v)} fields>"
+        else:
+            out[k] = v
+    return out
+
+
+def events_to_trace(events, name: str = "run") -> dict:
+    """One run's events (dicts, any schema version) -> a Chrome
+    trace-event JSON object ``{"traceEvents": [...]}``."""
+    pid = 1
+    trace = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+              "args": {"name": name}}]
+    for tid, tname in _TID_NAMES.items():
+        trace.append({"name": "thread_name", "ph": "M", "pid": pid,
+                      "tid": tid, "args": {"name": tname}})
+
+    # Pass 1: per-round open timestamps (earliest event naming the
+    # round) and the overall clock extent.
+    round_open = {}
+    t_max = 0.0
+    for e in events:
+        t = e.get("t")
+        if not isinstance(t, (int, float)):
+            continue
+        t_max = max(t_max, float(t))
+        r = e.get("round")
+        if isinstance(r, (int, float)) and e.get("kind") != "heartbeat":
+            r = int(r)
+            round_open[r] = min(round_open.get(r, float(t)), float(t))
+
+    # Round spans: close each at the next round's open (fused spans
+    # surface as a burst of zero-ish-width rounds at the fetch
+    # boundary — faithful: that IS when the host learned about them).
+    opens = sorted(round_open.items())
+    for i, (r, t0) in enumerate(opens):
+        t1 = opens[i + 1][1] if i + 1 < len(opens) else max(t_max, t0)
+        trace.append({"name": f"round {r}", "ph": "X", "pid": pid,
+                      "tid": _TID_ROUNDS, "ts": _us(t0),
+                      "dur": max(_us(t1) - _us(t0), 1),
+                      "args": {"round": r}})
+
+    for e in events:
+        kind = e.get("kind")
+        t = e.get("t")
+        if kind is None or not isinstance(t, (int, float)):
+            continue
+        if kind == "compile":
+            dur_s = float(e.get("compile_s", 0.0) or 0.0)
+            ts = max(float(t) - dur_s, 0.0)   # t stamps the tail
+            trace.append({"name": f"compile {e.get('name', '?')}",
+                          "ph": "X", "pid": pid, "tid": _TID_COMPILES,
+                          "ts": _us(ts), "dur": max(_us(dur_s), 1),
+                          "args": _args_of(e)})
+        elif kind == "heartbeat":
+            for field in ("rss_mb", "rounds_per_s"):
+                if isinstance(e.get(field), (int, float)):
+                    trace.append({"name": field, "ph": "C", "pid": pid,
+                                  "tid": 0, "ts": _us(t),
+                                  "args": {field: float(e[field])}})
+        elif kind == "profile":
+            # Aggregate phase totals laid end to end from t=0: not real
+            # intervals (count/mean in args say so), but the relative
+            # widths ARE the timing attribution.
+            cursor = 0.0
+            for pname, row in (e.get("phases") or {}).items():
+                total = float(row.get("total_s", 0.0))
+                trace.append({"name": pname, "ph": "X", "pid": pid,
+                              "tid": _TID_PHASES, "ts": _us(cursor),
+                              "dur": max(_us(total), 1),
+                              "args": {"count": row.get("count"),
+                                       "mean_ms": row.get("mean_ms"),
+                                       "aggregate": True}})
+                cursor += total
+        elif kind in _INSTANT_KINDS:
+            label = kind if kind != "lifecycle" else (
+                f"lifecycle:{e.get('phase', '?')}")
+            trace.append({"name": label, "ph": "i", "pid": pid,
+                          "tid": _INSTANT_KINDS[kind], "ts": _us(t),
+                          "s": "t", "args": _args_of(e)})
+        # round/defense/attack/cost/etc. are covered by the round spans
+        # and would only duplicate tooltips.
+    return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
+def export_trace(jsonl_path: str, out_path: Optional[str] = None,
+                 name: Optional[str] = None, validate: bool = False) -> str:
+    """Read one run JSONL (torn tails tolerated — a crashed run's trace
+    is exactly the interesting one) and write the trace JSON next to it
+    (``<log>.trace.json``) or to ``out_path``.  Returns the path."""
+    events = list(iter_events(jsonl_path, validate=validate,
+                              skip_bad=True))
+    trace = events_to_trace(
+        events, name=name or os.path.basename(jsonl_path))
+    problems = validate_trace(trace)
+    if problems:     # the exporter must never emit an unloadable trace
+        raise ValueError(f"exporter bug: {problems[:3]}")
+    out_path = out_path or jsonl_path + ".trace.json"
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    return out_path
+
+
+# Phase types this exporter emits; validation is over these (a viewer
+# accepts more, but anything else coming out of events_to_trace is a
+# bug).
+_KNOWN_PH = {"X", "i", "C", "M"}
+
+
+def validate_trace(obj) -> list:
+    """Check a trace object against the Chrome trace-event schema rules
+    the viewers rely on; returns a list of problem strings (empty =
+    loadable)."""
+    problems = []
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        return ["trace must be a JSON object with a 'traceEvents' list"]
+    evs = obj["traceEvents"]
+    if not isinstance(evs, list):
+        return ["'traceEvents' must be a list"]
+    for i, e in enumerate(evs):
+        where = f"traceEvents[{i}]"
+        if not isinstance(e, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        ph = e.get("ph")
+        if ph not in _KNOWN_PH:
+            problems.append(f"{where}: unknown ph {ph!r}")
+            continue
+        if not isinstance(e.get("name"), str) or not e["name"]:
+            problems.append(f"{where}: missing/empty name")
+        for field in ("pid", "tid"):
+            if not isinstance(e.get(field), int):
+                problems.append(f"{where}: {field} must be an int")
+        if ph != "M":
+            ts = e.get("ts")
+            if not isinstance(ts, int) or ts < 0:
+                problems.append(f"{where}: ts must be a non-negative "
+                                f"integer (microseconds), got {ts!r}")
+        if ph == "X":
+            dur = e.get("dur")
+            if not isinstance(dur, int) or dur <= 0:
+                problems.append(f"{where}: 'X' event needs integer "
+                                f"dur > 0, got {dur!r}")
+        if ph == "C":
+            args = e.get("args")
+            if (not isinstance(args, dict) or not args or not all(
+                    isinstance(v, (int, float)) for v in args.values())):
+                problems.append(f"{where}: 'C' event needs numeric args")
+        if ph == "M":
+            if not isinstance(e.get("args", {}).get("name"), str):
+                problems.append(f"{where}: metadata event needs "
+                                f"args.name")
+        if ph == "i" and e.get("s") not in (None, "g", "p", "t"):
+            problems.append(f"{where}: instant scope must be g/p/t")
+    return problems
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: Optional[str]):
+    """Opt-in REAL profiler capture: under ``FL_TEST_TPU=1`` (the same
+    gate the hardware-bound tests use) this wraps ``jax.profiler``
+    start/stop trace around the block, producing an XLA-level
+    TensorBoard/Perfetto capture in ``log_dir``.  Anywhere else — no
+    log_dir, or no FL_TEST_TPU — it is a no-op, so callers can wrap
+    capture regions unconditionally without ever touching a backend
+    whose relay may be dead (CLAUDE.md)."""
+    if not log_dir or os.environ.get("FL_TEST_TPU") != "1":
+        yield
+        return
+    from attacking_federate_learning_tpu.utils.profiling import xla_trace
+    with xla_trace(log_dir):
+        yield

@@ -710,10 +710,19 @@ def main():
         )
         from attacking_federate_learning_tpu.data.datasets import load_dataset
 
+        from attacking_federate_learning_tpu.utils.lifecycle import (
+            run_id_for
+        )
+
         for n_clients in (10, 512):
             cfg = ExperimentConfig(
                 dataset="SYNTH_MNIST", users_count=n_clients,
                 mal_prop=0.24, batch_size=64, epochs=1, defense="Krum")
+            # Config-hash identity: the join key between this BENCH
+            # record and the run registry (utils/registry.py ingests
+            # BENCH_*.json; 'run_ids' is how its rows join runs/).
+            RESULT.setdefault("run_ids", {})[
+                f"fl_round_{n_clients}c"] = run_id_for(cfg)
             ds = load_dataset(cfg.dataset, seed=0, synth_train=8192,
                               synth_test=512)
             exp = FederatedExperiment(cfg, attacker=DriftAttack(1.5),
@@ -745,10 +754,17 @@ def main():
         from attacking_federate_learning_tpu.data.datasets import load_dataset
 
         def backdoor_rps(fused, n_clients=32, reps=10):
+            from attacking_federate_learning_tpu.utils.lifecycle import (
+                run_id_for
+            )
+
             cfg = ExperimentConfig(
                 dataset="SYNTH_MNIST", users_count=n_clients, mal_prop=0.25,
                 batch_size=32, epochs=1, defense="TrimmedMean",
                 backdoor="pattern", backdoor_fused=fused)
+            RESULT.setdefault("run_ids", {})[
+                f"backdoor_{'fused' if fused else 'staged'}"] = (
+                run_id_for(cfg))
             ds = load_dataset(cfg.dataset, seed=0, synth_train=4096,
                               synth_test=256)
             exp = FederatedExperiment(

@@ -22,9 +22,14 @@ def test_grid_cells_and_guard_skip(tmp_path):
         ("Bulyan", "alie")}
     ran = [r for r in results if "final_accuracy" in r]
     assert all(0.0 <= r["final_accuracy"] <= 100.0 for r in ran)
+    # Every cell (ran AND skipped) carries its config-hash run_id — the
+    # join key against the cross-run registry (utils/registry.py).
+    assert all("run_id" in r for r in results)
+    assert len({r["run_id"] for r in results}) == 4   # distinct configs
     # Summary written incrementally, one JSON line per cell.
     lines = [json.loads(x) for x in out_path.read_text().splitlines()]
     assert len(lines) == 4
+    assert all(x["run_id"] for x in lines)
 
 
 def test_grid_none_attack_sets_zero_malicious(tmp_path):

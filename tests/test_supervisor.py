@@ -122,7 +122,9 @@ def test_resume_gated_on_own_progress(tmp_path):
 
 
 def test_supervisor_emits_valid_v3_events(tmp_path):
-    from attacking_federate_learning_tpu.utils.metrics import iter_events
+    from attacking_federate_learning_tpu.utils.metrics import (
+        SCHEMA_VERSION, iter_events
+    )
 
     sup = _load("supervisor")
     s = _sup(sup, CHILD, events=str(tmp_path / "e.jsonl"))
@@ -130,7 +132,9 @@ def test_supervisor_emits_valid_v3_events(tmp_path):
     s.emit("degrade", failure="oom", step="batch_halved_to_16")
     events = list(iter_events(str(tmp_path / "e.jsonl")))
     assert [e["phase"] for e in events] == ["supervise_start", "degrade"]
-    assert all(e["v"] == 3 for e in events)
+    # 'lifecycle' needs >= v3 (KIND_MIN_VERSION); the writer stamps the
+    # current schema version (v4 since the cross-run observatory).
+    assert all(e["v"] == SCHEMA_VERSION and e["v"] >= 3 for e in events)
 
 
 def test_event_age_heartbeat_aware(tmp_path):

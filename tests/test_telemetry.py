@@ -284,8 +284,12 @@ def test_schema_roundtrip_every_engine_kind(tmp_path):
                    jsonl_name="roundtrip4") as logger:
         exp4.cost_report(logger)
         logger.record(**logger.heartbeat_fields())
+        # v4: the science gate's verdict kind (tools/science_gate.py
+        # emits these; synthesized here like the heartbeat above).
+        logger.record(kind="gate", cell="krum_alie05", status="pass")
         # v3: a journaled run emits the 'lifecycle' kind from the
-        # engine itself (start/complete; utils/lifecycle.py).
+        # engine itself (start/complete; utils/lifecycle.py) — and, as
+        # of v4, the run-finish 'registry' stamp.
         exp4.run(logger,
                  journal=RunJournal(str(tmp_path / "runs"), "roundtrip4"))
         path4 = logger.jsonl_path

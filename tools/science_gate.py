@@ -1,0 +1,355 @@
+#!/usr/bin/env python
+"""Deterministic behavioral-drift gate: the science, machine-checked.
+
+tools/perf_gate.py pins the COMPILED PROGRAMS (static HLO cost facts);
+nothing pins the BEHAVIOR — the defense x attack accuracy/ASR surface
+that is the paper's entire contribution.  Through PR 4 that baseline
+lived in hand-maintained tables (PARITY.md, GRID_RESULTS.md) and in the
+behavioral tests' generous directional margins; a constant drifting by
+a few points (an attack z, a trim fraction, a selection quirk) could
+slide through every margin and silently rewrite the science.
+
+This gate replays a pinned set of SYNTH_MNIST_HARD defense x attack
+cells — seeded, CPU, short-round, the same low-SNR dataset the
+behavioral tests pin (tests/test_behavior.py; CLAUDE.md "behavioral
+tuning facts") — and diffs final/max accuracy, backdoor ASR and Krum
+selection concentration against the checked-in BEHAVIOR_BASELINE.json.
+
+Tolerance policy (ARCHITECTURE.md "Run registry & science gate"):
+
+- metrics with ``band == 0`` must match EXACTLY — an identical program
+  on an identical (env, seed) replays bit-for-bit, so any drift is a
+  real behavioral change;
+- selection-mediated metrics carry a small MEASURED band: PR 4's
+  ulp-tie adjudication (tests/test_distance_impl.py, bench.py
+  adjudicate_f32_flip) showed Krum/Bulyan selections rest on f32
+  near-ties where a legal compile-schedule change (reduction reorder,
+  re-fusion) flips a pick at 1 ulp and the flip cascades into the
+  trajectory.  Exact-match there would veto legal optimizations; the
+  bands bound how far a legal flip was ever observed to move each
+  metric.
+
+The baseline records its environment (jax/jaxlib/platform); on a
+mismatch the comparison is meaningless and the gate SKIPS with a loud
+notice and exit 0 unless ``--strict-env`` (perf_gate's policy) —
+regenerate with ``--update`` after a toolchain bump.
+
+Usage:
+    python tools/science_gate.py                   # gate
+    python tools/science_gate.py --update          # (re)generate
+    python tools/science_gate.py --cells krum_alie05,nodefense_clean
+    python tools/science_gate.py --events logs/gate.jsonl   # v4 'gate'
+                                                            # events
+
+Exit status: 0 clean (or env-skip), 1 on any named cell.metric drift,
+2 when the baseline is missing.  CI-wired via tools/smoke.sh leg 5 and
+tests/test_science_gate.py (which exercises the diff on perturbed
+measurements — the "a constant changed" failure mode — without paying
+for cell replays).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BASELINE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "BEHAVIOR_BASELINE.json")
+
+# The pinned grid slice: the behavioral-test constants (n=19, ~21%
+# malicious, batch 64 — ALIE strength depends on 1/sqrt(batch),
+# CLAUDE.md) at gate-sized rounds.  Cells cover the mechanisms the
+# paper's surface is made of: the clean baselines, the z-dependent ALIE
+# split (z=0.5 defeats averaging AND Krum; z=1.5 degrades the
+# coordinate-wise and Bulyan estimators), and backdoor ASR.
+ROUNDS = 10
+CELLS = {
+    "nodefense_clean": dict(defense="NoDefense", attack=None),
+    "nodefense_alie05": dict(defense="NoDefense", z=0.5),
+    "krum_clean": dict(defense="Krum", attack=None, telemetry=True),
+    "krum_alie05": dict(defense="Krum", z=0.5, telemetry=True),
+    "krum_alie15": dict(defense="Krum", z=1.5, telemetry=True),
+    "trimmedmean_alie15": dict(defense="TrimmedMean", z=1.5),
+    "bulyan_alie15": dict(defense="Bulyan", z=1.5),
+    "backdoor_trimmedmean": dict(defense="TrimmedMean", backdoor=True),
+}
+
+# Per-metric tolerance bands (absolute; 0 = exact).  Authored here,
+# recorded into the baseline at --update so the gate run states the
+# policy it was compared under.  Rationale: mean/coordinate-wise paths
+# with no data-dependent selection replay exactly; selection-mediated
+# cells (Krum picks, Bulyan's select+trim, the backdoor's clip-envelope
+# race) may legally move under a 1-ulp compile-schedule flip
+# (tests/test_distance_impl.py::test_engine_bulyan_blockwise — the
+# measured mechanism), so they carry bands sized generously below any
+# real behavioral effect (the PARITY table's effects are tens of
+# points).
+DEFAULT_BANDS = {"final_accuracy": 0.0, "max_accuracy": 0.0}
+CELL_BANDS = {
+    "krum_clean": {"final_accuracy": 2.0, "max_accuracy": 2.0,
+                   "top1_share": 0.1, "malicious_share": 0.05,
+                   "distinct_winners": 2},
+    "krum_alie05": {"final_accuracy": 3.0, "max_accuracy": 3.0,
+                    "top1_share": 0.1, "malicious_share": 0.1,
+                    "distinct_winners": 2},
+    "krum_alie15": {"final_accuracy": 2.0, "max_accuracy": 2.0,
+                    "top1_share": 0.1, "malicious_share": 0.05,
+                    "distinct_winners": 2},
+    "bulyan_alie15": {"final_accuracy": 5.0, "max_accuracy": 5.0},
+    "trimmedmean_alie15": {"final_accuracy": 2.0, "max_accuracy": 2.0},
+    "backdoor_trimmedmean": {"final_accuracy": 2.0, "max_accuracy": 2.0,
+                             "final_asr": 5.0},
+}
+
+
+def environment() -> dict:
+    import importlib.metadata as md
+
+    import jax
+
+    def _v(pkg):
+        try:
+            return md.version(pkg)
+        except Exception:
+            return "unknown"
+
+    return {"jax": _v("jax"), "jaxlib": _v("jaxlib"),
+            "platform": jax.devices()[0].platform}
+
+
+def measure_cell(name: str, spec: dict, rounds: int = ROUNDS) -> dict:
+    """Replay one pinned cell; returns {metric: value}.  Seeded,
+    short-round, CPU-sized — the behavioral-test recipe
+    (tests/conftest.py:hard_final_accuracy) at gate cadence."""
+    import numpy as np
+
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.attacks import (
+        DriftAttack, NoAttack, make_attacker
+    )
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+
+    backdoor = spec.get("backdoor", False)
+    attacked = spec.get("attack", "alie") is not None or backdoor
+    cfg = ExperimentConfig(
+        dataset=C.SYNTH_MNIST_HARD, users_count=19,
+        mal_prop=0.21 if attacked else 0.0, batch_size=64,
+        epochs=rounds, test_step=max(1, rounds // 2), seed=0,
+        synth_train=4000, synth_test=1000,
+        defense=spec["defense"],
+        num_std=spec.get("z", 1.5),
+        backdoor="pattern" if backdoor else False,
+        telemetry=bool(spec.get("telemetry")))
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=cfg.synth_train,
+                      synth_test=cfg.synth_test)
+    if backdoor:
+        attacker = make_attacker(cfg, dataset=ds, name="backdoor")
+    elif spec.get("attack", "alie") is None:
+        attacker = NoAttack()
+    else:
+        attacker = DriftAttack(cfg.num_std)
+    exp = FederatedExperiment(cfg, attacker=attacker, dataset=ds)
+
+    accs, winners = [], []
+    eval_rounds = {t for t in range(rounds)
+                   if t % cfg.test_step == 0 or t == rounds - 1}
+    for t in range(rounds):
+        exp.run_round(t)
+        if cfg.telemetry and exp.last_round_telemetry is not None:
+            mask = np.asarray(
+                exp.last_round_telemetry.get("defense_selection_mask"))
+            if mask.ndim == 1 and np.isfinite(mask).all():
+                winners.append(int(np.argmax(mask)))
+        if t in eval_rounds:
+            _, correct = exp.evaluate(exp.state.weights)
+            accs.append(100.0 * float(correct) / len(ds.test_y))
+    out = {"final_accuracy": round(accs[-1], 4),
+           "max_accuracy": round(max(accs), 4)}
+    if backdoor:
+        out["final_asr"] = round(
+            float(exp.attacker.test_asr(exp.state.weights)), 4)
+    if winners:
+        counts: dict = {}
+        for w in winners:
+            counts[w] = counts.get(w, 0) + 1
+        top1 = max(counts.values())
+        out["top1_share"] = round(top1 / len(winners), 4)
+        out["distinct_winners"] = len(counts)
+        out["malicious_share"] = round(
+            sum(1 for w in winners if w < exp.m_mal) / len(winners), 4)
+    return out
+
+
+def bands_for(cell: str) -> dict:
+    return {**DEFAULT_BANDS, **CELL_BANDS.get(cell, {})}
+
+
+def measure(cells, rounds: int = ROUNDS) -> dict:
+    out = {}
+    for name in cells:
+        t0 = time.time()
+        vals = measure_cell(name, CELLS[name], rounds)
+        out[name] = {m: {"value": v, "band": bands_for(name).get(m, 0.0)}
+                     for m, v in vals.items()}
+        print(f"  measured {name} ({time.time() - t0:.1f} s): "
+              + "  ".join(f"{m}={v}" for m, v in vals.items()))
+    return out
+
+
+def diff(baseline: dict, measured: dict) -> list:
+    """'<cell>.<metric>: ...' drift strings (empty = clean).  Bands come
+    from the BASELINE (the policy in force when it was generated);
+    missing cells/metrics are drifts too — a silently vanished metric
+    must not pass the gate."""
+    problems = []
+    for cell, metrics in baseline.items():
+        got_cell = measured.get(cell)
+        if got_cell is None:
+            problems.append(f"{cell}: cell not measured")
+            continue
+        for metric, want in metrics.items():
+            got = got_cell.get(metric)
+            if got is None:
+                problems.append(f"{cell}.{metric}: metric missing from "
+                                f"the measurement")
+                continue
+            w = want["value"]
+            g = got["value"] if isinstance(got, dict) else got
+            band = float(want.get("band", 0.0))
+            if band == 0.0:
+                if g != w:
+                    problems.append(
+                        f"{cell}.{metric}: measured {g} != baseline {w} "
+                        f"(exact-match metric: this cell replays "
+                        f"bit-deterministically)")
+            elif abs(float(g) - float(w)) > band:
+                problems.append(
+                    f"{cell}.{metric}: measured {g} vs baseline {w} "
+                    f"(|delta| {abs(float(g) - float(w)):.4g} > "
+                    f"band {band} — beyond any legal ulp-tie flip)")
+        for metric in got_cell:
+            if metric not in metrics:
+                problems.append(f"{cell}.{metric}: new metric not in "
+                                f"baseline (regenerate with --update)")
+    return problems
+
+
+def emit_gate_events(path: str, cells: dict, problems: list,
+                     status_all: str):
+    """One v4 'gate' event per cell (utils/metrics.py schema) — the
+    gate's verdict in the same stream every other rollup lives in."""
+    from attacking_federate_learning_tpu.utils.metrics import (
+        SCHEMA_VERSION, validate_event
+    )
+
+    bad_cells = {p.split(".", 1)[0].split(":", 1)[0] for p in problems}
+    with open(path, "a") as f:
+        for cell, metrics in cells.items():
+            rec = {"kind": "gate", "cell": cell,
+                   "status": "fail" if cell in bad_cells else status_all,
+                   "v": SCHEMA_VERSION, "t": round(time.time(), 3)}
+            for m, v in metrics.items():
+                rec[m] = v["value"] if isinstance(v, dict) else v
+            validate_event(rec)
+            f.write(json.dumps(rec) + "\n")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Deterministic behavioral-drift gate over pinned "
+                    "SYNTH_MNIST_HARD defense x attack cells.")
+    p.add_argument("--baseline", default=BASELINE)
+    p.add_argument("--update", action="store_true",
+                   help="write a fresh baseline instead of gating")
+    p.add_argument("--cells", default=",".join(CELLS),
+                   help="comma-separated subset of the pinned cells")
+    p.add_argument("--rounds", type=int, default=ROUNDS,
+                   help="rounds per cell (changing this invalidates "
+                        "the baseline; it is recorded there)")
+    p.add_argument("--strict-env", action="store_true",
+                   help="treat a baseline/environment mismatch as a "
+                        "failure instead of a skip")
+    p.add_argument("--events", default=None, metavar="JSONL",
+                   help="append one v4 'gate' event per cell to this "
+                        "run log")
+    args = p.parse_args(argv)
+
+    cells = [c.strip() for c in args.cells.split(",") if c.strip()]
+    unknown = [c for c in cells if c not in CELLS]
+    if unknown:
+        print(f"unknown cells: {unknown} (known: {sorted(CELLS)})")
+        return 2
+
+    env = environment()
+    if env["platform"] != "cpu":
+        # The pinned cells are CPU replays by construction — never race
+        # a TPU relay window for a CI gate (CLAUDE.md).
+        print(f"SKIP science_gate: backend is {env['platform']!r}, the "
+              f"pinned cells are CPU replays (set JAX_PLATFORMS=cpu)")
+        return 0 if not args.strict_env else 1
+
+    if args.update:
+        measured = measure(cells, args.rounds)
+        payload = {"env": env, "rounds": args.rounds,
+                   "generated": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                   "argv": list(argv or sys.argv[1:]),
+                   "policy": "band 0 = exact (bit-deterministic "
+                             "replay); band > 0 = measured ulp-tie "
+                             "envelope (see module docstring)",
+                   "cells": measured}
+        with open(args.baseline, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.baseline} ({len(measured)} cells, "
+              f"jax {env['jax']}, {env['platform']}, "
+              f"{args.rounds} rounds)")
+        return 0
+
+    if not os.path.exists(args.baseline):
+        print(f"no baseline at {args.baseline}; run with --update first")
+        return 2
+    with open(args.baseline) as f:
+        base = json.load(f)
+    benv = base.get("env", {})
+    if benv != env or base.get("rounds") != args.rounds:
+        msg = (f"environment mismatch: baseline "
+               f"(env {benv}, rounds {base.get('rounds')}) vs current "
+               f"(env {env}, rounds {args.rounds}) — behavioral "
+               f"trajectories are only comparable within one (jax, "
+               f"platform, rounds) tuple; regenerate with --update")
+        if args.strict_env:
+            print(f"FAIL science_gate: {msg}")
+            return 1
+        print(f"SKIP science_gate: {msg}")
+        return 0
+
+    baseline_cells = {c: v for c, v in base["cells"].items() if c in cells}
+    measured = measure(cells, args.rounds)
+    problems = diff(baseline_cells, measured)
+    if args.events:
+        emit_gate_events(args.events, measured, problems,
+                         "fail" if problems else "pass")
+    if problems:
+        print(f"FAIL science_gate: {len(problems)} behavioral drift(s)")
+        for prob in problems:
+            print(f"  {prob}")
+        return 1
+    n = sum(len(v) for v in measured.values())
+    print(f"ok   science_gate: {len(cells)} cells, {n} metrics match "
+          f"BEHAVIOR_BASELINE.json (exact where bit-deterministic, "
+          f"measured ulp-tie bands elsewhere)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,25 +1,32 @@
 #!/usr/bin/env bash
 # One-liner CI smoke: event-schema validation + fault matrix + crash
-# matrix + perf gate.
+# matrix + perf gate + science gate + registry selfcheck.
 #
-#   bash tools/smoke.sh            # all four, CPU-pinned
+#   bash tools/smoke.sh            # all six, CPU-pinned
 #   bash tools/smoke.sh --fast     # skip the fault + crash matrices
 #                                  # (the two slowest legs)
 #
 # Legs (each independently CI-wired through tests/ as well):
 #   1. tools/check_events.py over every run JSONL in logs/ (schema
-#      v1-v3: round/eval/.../fault, compile/cost/heartbeat, lifecycle)
-#      — skipped when logs/ has no .jsonl yet;
+#      v1-v4: round/eval/.../fault, compile/cost/heartbeat, lifecycle,
+#      registry/gate) — skipped when logs/ has no .jsonl yet;
 #   2. tools/fault_matrix.py — 5-round fault x defense sweep, emitted
 #      'fault' events diffed against the host replay of the schedule;
 #   3. tools/crash_matrix.py — supervised preempt/resume at a seeded
 #      round x {fused, staged, faulted} x 2 defenses: bounded retries,
 #      exactly-once journal, clean exit (tools/supervisor.py);
 #   4. tools/perf_gate.py — deterministic static-HLO perf gate against
-#      PERF_BASELINE.json (FLOPs/bytes exact, memory within tolerance).
+#      PERF_BASELINE.json (FLOPs/bytes exact, memory within tolerance);
+#   5. tools/science_gate.py — deterministic behavioral-drift gate:
+#      pinned SYNTH_MNIST_HARD defense x attack cells against
+#      BEHAVIOR_BASELINE.json (exact where bit-deterministic, measured
+#      ulp-tie bands elsewhere);
+#   6. 'runs selfcheck' — cross-run registry over runs/ (incl. the
+#      supervised-run artifacts legs 2-3 leave behind): index refresh
+#      idempotence + every entry resolvable (utils/registry.py).
 #
-# Exit: nonzero if any leg fails.  Always CPU (the gate's baseline is a
-# CPU artifact, and the matrices must not touch a TPU capture).
+# Exit: nonzero if any leg fails.  Always CPU (the gates' baselines are
+# CPU artifacts, and the matrices must not touch a TPU capture).
 set -u
 cd "$(dirname "$0")/.."
 
@@ -31,24 +38,47 @@ fail=0
 shopt -s nullglob
 jsonls=(logs/*.jsonl)
 if [ ${#jsonls[@]} -gt 0 ]; then
-    echo "== smoke 1/4: check_events (${#jsonls[@]} logs) =="
+    echo "== smoke 1/6: check_events (${#jsonls[@]} logs) =="
     python tools/check_events.py "${jsonls[@]}" || fail=1
 else
-    echo "== smoke 1/4: check_events — no logs/*.jsonl yet, skipped =="
+    echo "== smoke 1/6: check_events — no logs/*.jsonl yet, skipped =="
 fi
 
+crash_work=""
 if [ "${1:-}" != "--fast" ]; then
-    echo "== smoke 2/4: fault_matrix =="
+    echo "== smoke 2/6: fault_matrix =="
     python tools/fault_matrix.py || fail=1
-    echo "== smoke 3/4: crash_matrix (supervised preempt/resume) =="
-    python tools/crash_matrix.py || fail=1
+    echo "== smoke 3/6: crash_matrix (supervised preempt/resume) =="
+    # Keep the matrix's run stores: leg 6 registry-checks them.
+    crash_work="$(mktemp -d -t crash_matrix_XXXXXX)"
+    python tools/crash_matrix.py --workdir "$crash_work" || fail=1
 else
-    echo "== smoke 2/4: fault_matrix — skipped (--fast) =="
-    echo "== smoke 3/4: crash_matrix — skipped (--fast) =="
+    echo "== smoke 2/6: fault_matrix — skipped (--fast) =="
+    echo "== smoke 3/6: crash_matrix — skipped (--fast) =="
 fi
 
-echo "== smoke 4/4: perf_gate =="
+echo "== smoke 4/6: perf_gate =="
 python tools/perf_gate.py || fail=1
+
+echo "== smoke 5/6: science_gate (behavioral drift) =="
+python tools/science_gate.py || fail=1
+
+echo "== smoke 6/6: runs selfcheck (registry) =="
+python -m attacking_federate_learning_tpu.cli runs selfcheck || fail=1
+if [ -n "$crash_work" ]; then
+    # The registry over the crash matrix's preempt/resume artifacts:
+    # every supervised cell's run store must index, list and selfcheck
+    # (refresh idempotence + resolvability) like any other runs/.
+    for d in "$crash_work"/*/runs; do
+        [ -d "$d" ] || continue
+        echo "-- registry over crash-matrix artifacts: $d --"
+        python -m attacking_federate_learning_tpu.cli runs \
+            --run-dir "$d" --bench '' --progress '' list || fail=1
+        python -m attacking_federate_learning_tpu.cli runs \
+            --run-dir "$d" --bench '' --progress '' selfcheck || fail=1
+    done
+    rm -rf "$crash_work"
+fi
 
 if [ $fail -ne 0 ]; then
     echo "SMOKE FAILED"

@@ -124,8 +124,14 @@ class Supervisor:
         return self.parser.parse_args(self.child_args + self.degrade_flags)
 
     def _checkpoint_exists(self) -> bool:
-        ckdir = os.path.join(self.cfg.run_dir, self.cfg.dataset)
-        return bool(glob.glob(os.path.join(ckdir, "*.npz")))
+        # PR 5 layout: a journaled child's auto-checkpoints live under
+        # its private runs/<run_id>/; the shared runs/<dataset>/ still
+        # holds the best-accuracy save and pre-migration autos.
+        for ckdir in (os.path.join(self.cfg.run_dir, self.run_id),
+                      os.path.join(self.cfg.run_dir, self.cfg.dataset)):
+            if glob.glob(os.path.join(ckdir, "*.npz")):
+                return True
+        return False
 
     def build_cmd(self, attempt):
         if self.raw:
