@@ -194,6 +194,40 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default) or the native host kernel (the "
                         "CPU-backend 10k opt-in; same standard as "
                         "--trimmed-mean-impl)")
+    p.add_argument("--aggregation", default="flat",
+                   choices=["flat", "hierarchical"],
+                   help="'flat' = reference path (one (n, d) matrix, one "
+                        "defense call); 'hierarchical' streams the client "
+                        "axis through --megabatch-sized scan shards with "
+                        "per-shard tier-1 robust estimates and a tier-2 "
+                        "cross-shard reduction — the (n, d)/(n, n) arrays "
+                        "never materialize (ops/federated.py)")
+    p.add_argument("--megabatch", default=0, type=int, metavar="M",
+                   help="hierarchical tier-1 shard size m (must divide "
+                        "--users-count, >= 2 shards); round peak memory "
+                        "scales with m*d instead of n*d")
+    p.add_argument("--tier2-defense", default=None,
+                   choices=["NoDefense", "Krum", "TrimmedMean", "Bulyan",
+                            "Median"],
+                   help="tier-2 reducer over the (n/m, d) shard-estimate "
+                        "matrix (defenses/kernels.py shard_* entries); "
+                        "default: same family as -d/--defense")
+    p.add_argument("--mal-placement", default="spread",
+                   choices=["spread", "concentrated"],
+                   help="colluder placement across megabatches: 'spread' "
+                        "deals the malicious ids round-robin, "
+                        "'concentrated' packs them into the fewest shards "
+                        "(the colluders-own-a-shard scenario; only "
+                        "meaningful under --aggregation hierarchical)")
+    p.add_argument("--tier1-corrupted", default=None, type=int,
+                   metavar="F1",
+                   help="assumed per-shard corrupted bound for tier-1 "
+                        "(default: ceil(f / num_shards), the spread "
+                        "worst case)")
+    p.add_argument("--tier2-corrupted", default=None, type=int,
+                   metavar="F2",
+                   help="assumed corrupted-shard bound for tier-2 "
+                        "(default: ceil(f / megabatch))")
     p.add_argument("--distance-impl", default="auto",
                    choices=["auto", "xla", "pallas", "host", "ring",
                             "allgather"],
@@ -372,6 +406,12 @@ def config_from_args(args) -> ExperimentConfig:
         cclip_iters=args.cclip_iters,
         trimmed_mean_impl=args.trimmed_mean_impl,
         median_impl=args.median_impl,
+        aggregation=args.aggregation,
+        megabatch=args.megabatch,
+        tier2_defense=args.tier2_defense,
+        mal_placement=args.mal_placement,
+        tier1_corrupted=args.tier1_corrupted,
+        tier2_corrupted=args.tier2_corrupted,
     )
 
 

@@ -147,6 +147,37 @@ class ExperimentConfig:
     # --- defense --------------------------------------------------------
     defense: str = "NoDefense"       # reference main.py:112
 
+    # --- hierarchical (two-tier) aggregation ----------------------------
+    # 'flat' (the default) is the reference path: one (n, d) gradient
+    # matrix, one defense call.  'hierarchical' streams the client axis
+    # through lax.scan megabatches of static size `megabatch` (m ≪ n):
+    # per-megabatch tier-1 robust estimates (the same mask-aware kernels,
+    # `defense` above), then a tier-2 robust reduction over the (n/m, d)
+    # estimate matrix (defenses/kernels.py shard_* entries) — the full
+    # (n, d) and (n, n) arrays never exist (ops/federated.py;
+    # ARCHITECTURE.md "Hierarchical aggregation").  The flat path's
+    # compiled HLO is byte-identical with these knobs at any value
+    # (tests/test_hierarchy.py pins it).
+    aggregation: str = "flat"        # 'flat' | 'hierarchical'
+    # Megabatch (tier-1 shard) size m; must divide users_count with at
+    # least 2 shards.  Peak round memory scales with m·d, not n·d.
+    megabatch: int = 0
+    # Tier-2 reducer over shard estimates; None = same family as
+    # `defense`.  Restricted to the mask-aware kernel set.
+    tier2_defense: Optional[str] = None
+    # Colluder placement across megabatches — a genuine Byzantine
+    # surface, not an implementation detail (ops/federated.py):
+    # 'spread' deals the malicious ids [0, f) round-robin over shards,
+    # 'concentrated' packs them into the fewest shards.
+    mal_placement: str = "spread"
+    # Assumed corrupted bounds per tier; None derives the spread-worst-
+    # case defaults ceil(f/S) and ceil(f/m) (ops/federated.py
+    # tier1_assumed/tier2_assumed).  Explicit values let experiments
+    # probe mismatched-assumption regimes (and keep Bulyan's
+    # 4f+3 validity satisfiable at small shard counts).
+    tier1_corrupted: Optional[int] = None
+    tier2_corrupted: Optional[int] = None
+
     # --- evaluation / io ------------------------------------------------
     test_step: int = 5               # reference main.py:58
     checkpoint_acc_threshold: float = 70.0  # reference main.py:84
@@ -413,6 +444,38 @@ class ExperimentConfig:
             raise ValueError(
                 f"median_impl must be 'xla' or 'host', "
                 f"got {self.median_impl!r}")
+        if self.aggregation not in ("flat", "hierarchical"):
+            raise ValueError(
+                f"aggregation must be 'flat' or 'hierarchical', "
+                f"got {self.aggregation!r}")
+        if self.mal_placement not in ("spread", "concentrated"):
+            raise ValueError(
+                f"mal_placement must be 'spread' or 'concentrated', "
+                f"got {self.mal_placement!r}")
+        if self.megabatch < 0:
+            raise ValueError(f"megabatch must be >= 0, got {self.megabatch}")
+        _TIER2 = ("NoDefense", "Krum", "TrimmedMean", "Bulyan", "Median")
+        if self.tier2_defense is not None and self.tier2_defense not in _TIER2:
+            raise ValueError(
+                f"tier2_defense must be one of {_TIER2}, "
+                f"got {self.tier2_defense!r}")
+        for name in ("tier1_corrupted", "tier2_corrupted"):
+            v = getattr(self, name)
+            if v is not None and v < 0:
+                raise ValueError(f"{name} must be >= 0, got {v}")
+        if self.aggregation == "hierarchical":
+            if self.megabatch < 1:
+                raise ValueError(
+                    "hierarchical aggregation needs megabatch >= 1 "
+                    "(the tier-1 shard size; --megabatch)")
+            if self.users_count % self.megabatch:
+                raise ValueError(
+                    f"megabatch must divide users_count "
+                    f"({self.users_count} % {self.megabatch} != 0)")
+            if self.users_count // self.megabatch < 2:
+                raise ValueError(
+                    f"hierarchical aggregation needs >= 2 shards "
+                    f"(n={self.users_count}, m={self.megabatch})")
         if isinstance(self.faults, dict):
             # Checkpoint-JSON round trips and kwargs-style callers hand
             # a plain dict; coerce so every consumer sees a FaultConfig.

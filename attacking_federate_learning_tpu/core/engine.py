@@ -104,8 +104,13 @@ class FederatedExperiment:
                     f"participation={cfg.participation})")
         else:
             self.m, self.m_mal = self.n, self.f
-        # The defense only ever sees the round cohort.
-        check_defense_args(cfg.defense, self.m, self.m_mal)
+        # The defense only ever sees the round cohort (flat), or one
+        # megabatch / the shard-estimate matrix (hierarchical).
+        if cfg.aggregation == "hierarchical":
+            self._init_hierarchical()
+        else:
+            self._placement = None
+            check_defense_args(cfg.defense, self.m, self.m_mal)
         # Fault-injection subsystem (core/faults.py): None is the
         # zero-fault reference path — no fault state, no mask threading,
         # the compiled round program is bit-identical to the
@@ -251,6 +256,88 @@ class FederatedExperiment:
         self.evaluate = make_eval_fn(self.model, self.flat,
                                      self.dataset.test_x, self.dataset.test_y,
                                      cfg.batch_size)
+
+    # ------------------------------------------------------------------
+    def _init_hierarchical(self):
+        """Validate + plan the two-tier streaming round (ISSUE 6 /
+        ROADMAP item 1; ops/federated.py, ARCHITECTURE.md "Hierarchical
+        aggregation").
+
+        The client axis lives inside a scanned device program, so every
+        feature that needs the materialized (n, d) matrix — or a host
+        hop per round — is rejected here rather than failing deep in a
+        trace: per-round telemetry/round-stats (they thread (n,)-shaped
+        diagnostics out of the defense call), fault injection (the
+        quarantine mask is an (n,) row mask over the full matrix),
+        partial participation (cohort sampling composes with placement
+        in a follow-up), host streaming (one round per program by
+        design), and the opt-in host kernels (a pure_callback per
+        megabatch per scan step would marshal more than it saves)."""
+        cfg = self.cfg
+        from attacking_federate_learning_tpu.defenses.kernels import (
+            TIER2_DEFENSES, check_tier2_args
+        )
+        from attacking_federate_learning_tpu.ops.federated import (
+            make_placement, tier1_assumed, tier2_assumed
+        )
+
+        if cfg.participation < 1.0:
+            raise ValueError(
+                "hierarchical aggregation requires full participation "
+                "(placement assigns every client to a megabatch)")
+        if cfg.data_placement != "device":
+            raise ValueError(
+                "hierarchical aggregation requires "
+                "data_placement='device' (the scanned round gathers "
+                "each megabatch's batch on device)")
+        if cfg.telemetry or cfg.log_round_stats:
+            raise ValueError(
+                "hierarchical aggregation does not support "
+                "telemetry/log_round_stats yet (per-round diagnostics "
+                "are shaped by the full cohort)")
+        if cfg.faults is not None and cfg.faults.enabled:
+            raise ValueError(
+                "hierarchical aggregation does not support fault "
+                "injection yet (the quarantine mask spans the full "
+                "cohort); the tier-2 kernels' alive_counts seam is in "
+                "place for when it lands")
+        if cfg.backdoor and not cfg.backdoor_fused:
+            raise ValueError(
+                "hierarchical aggregation needs the fused backdoor "
+                "path (drop --backdoor-staged)")
+        if cfg.defense not in TIER2_DEFENSES:
+            raise ValueError(
+                f"hierarchical tier-1 defense must be one of "
+                f"{sorted(TIER2_DEFENSES)} (the mask-aware kernel "
+                f"set), got {cfg.defense!r}")
+        if cfg.distance_impl in ("ring", "allgather", "host"):
+            raise ValueError(
+                f"hierarchical aggregation supports distance_impl in "
+                f"auto/xla/pallas (got {cfg.distance_impl!r}): the "
+                f"per-megabatch distance pass must stay inside the "
+                f"scanned program")
+        for knob in ("trimmed_mean_impl", "median_impl",
+                     "bulyan_selection_impl", "bulyan_trim_impl"):
+            if getattr(cfg, knob) != "xla":
+                raise ValueError(
+                    f"hierarchical aggregation requires {knob}='xla' "
+                    f"(host kernels would pure_callback once per "
+                    f"megabatch per scan step)")
+
+        self._placement = make_placement(self.n, self.f, cfg.megabatch,
+                                         cfg.mal_placement)
+        S = self._placement.num_shards
+        self._tier1_f = (cfg.tier1_corrupted
+                         if cfg.tier1_corrupted is not None
+                         else tier1_assumed(self.f, S))
+        self._tier2_f = (cfg.tier2_corrupted
+                         if cfg.tier2_corrupted is not None
+                         else tier2_assumed(self.f, cfg.megabatch))
+        self._tier2_name = cfg.tier2_defense or cfg.defense
+        # Same validity bounds per tier that the flat path checks once.
+        check_tier2_args(cfg.defense, cfg.megabatch, self._tier1_f)
+        check_tier2_args(self._tier2_name, S, self._tier2_f)
+        self._tier2_fn = TIER2_DEFENSES[self._tier2_name]
 
     # ------------------------------------------------------------------
     def _wire_distance_defense(self, fn):
@@ -513,6 +600,8 @@ class FederatedExperiment:
 
     def _build_round_fns(self):
         cfg = self.cfg
+        if cfg.aggregation == "hierarchical":
+            return self._build_hier_round_fns()
 
         def ctx_for(state, t):
             return AttackContext(
@@ -785,6 +874,130 @@ class FederatedExperiment:
         self._finish_telemetry = finish_telemetry
 
     # ------------------------------------------------------------------
+    def _build_hier_round_fns(self):
+        """Two-tier streaming round (cfg.aggregation='hierarchical').
+
+        The round is the three federated primitives of ops/federated.py
+        composed inside one jit: ``broadcast`` (the server weights ride
+        the scan closure), ``client_map`` (a ``lax.scan`` over
+        megabatches of ``cfg.megabatch`` clients — gather that
+        megabatch's minibatch, compute its gradients, run the attack
+        seam on ITS malicious rows, reduce it to one tier-1 robust
+        estimate with the unchanged flat kernel), and ``shard_reduce``
+        (the tier-2 shard_* kernel over the (n/m, d) estimate matrix).
+        The full (n, d) gradient matrix and the (n, n) distance matrix
+        never exist: XLA reuses one megabatch's buffers across scan
+        steps, so peak round memory is O(m·d) (tools/perf_gate.py
+        ``--memproof`` pins it at the 10k north star).
+
+        ATTACK-SEAM SEMANTICS CHANGE (documented contract of the flag):
+        ``Attack.craft`` runs once per megabatch and sees only that
+        megabatch's malicious rows — ALIE-style cohort statistics are
+        per-megabatch envelopes, and under ``mal_placement='spread'``
+        each crafted vector is estimated from ~f/S rows instead of f.
+        Augmentation keys are per-round (like the flat path), so crop/
+        flip draws repeat across megabatches at equal row positions —
+        an accepted, documented deviation (CIFAR100 only).
+
+        Spans fuse exactly like the flat path: ``run_span`` drives the
+        same ``_fused_round`` / ``_fused_span`` entry points (cost
+        ledger names ``hier_round`` / ``hier_span``), and the nan guard
+        ORs each megabatch's crafted-rows isfinite flag."""
+        cfg = self.cfg
+        from attacking_federate_learning_tpu.ops.federated import (
+            client_map, shard_reduce
+        )
+
+        place = self._placement
+        m = place.megabatch
+        f1, f2, S = self._tier1_f, self._tier2_f, place.num_shards
+        tier2_fn = self._tier2_fn
+
+        def ctx_for(state, t):
+            return AttackContext(
+                original_params=state.weights,
+                learning_rate=faded_learning_rate(
+                    cfg.learning_rate, cfg.fading_rate, t),
+                round=t)
+
+        self._ctx_for = ctx_for
+        if not getattr(self.attacker, "fusable", True):
+            raise ValueError(
+                "hierarchical aggregation needs a fusable attack: the "
+                "client axis lives inside a scanned device program")
+        # Same predicate as the flat path (the in-program shadow-train
+        # nan guard), evaluated per megabatch over ITS crafted rows.
+        self._check_attack_nan = (
+            getattr(self.attacker, "checks_finite", False)
+            and self.m_mal > 0
+            and getattr(self.attacker, "num_std", 1) != 0)
+
+        def shard_fn(ids, c_mal, state, t):
+            """One megabatch: ids (m,) client ids (malicious first —
+            the per-megabatch mirror of the rows-[0, f) invariant),
+            c_mal its STATIC malicious count.  Returns the (d,) f32
+            tier-1 estimate and the megabatch's nan flag."""
+            shard_rows = self.shards[ids]
+            idx = round_batch_indices(
+                shard_rows, t, cfg.batch_size * cfg.local_steps)
+            xs, ys = self.train_x[idx], self.train_y[idx]
+            xs = self._apply_style(xs, ids)
+            xs = self._maybe_augment(xs, t)
+            k, B = cfg.local_steps, cfg.batch_size
+            xs = xs.reshape((m, k, B) + xs.shape[2:])
+            ys = ys.reshape((m, k, B))
+            lr_train = faded_learning_rate(cfg.learning_rate,
+                                           cfg.fading_rate, t)
+            lr_report = (lr_train if cfg.server_uses_faded_lr
+                         else cfg.learning_rate)
+            grads = self._client_update(state.weights, xs, ys, lr_train,
+                                        lr_report)
+            grads = grads.astype(self._grad_dtype)
+            if self.shardings is not None:
+                grads = self.shardings.constrain_grads(grads)
+            grads = self.attacker.apply(grads, c_mal, ctx_for(state, t))
+            bad = (
+                (~jnp.isfinite(grads[:c_mal].astype(jnp.float32))).any()
+                if (self._check_attack_nan and c_mal > 0)
+                else jnp.asarray(False))
+            est = self.defense_fn(grads, m, f1)
+            return est.astype(jnp.float32), bad
+
+        def hier_core(state, t):
+            ests, bads = client_map(shard_fn, place, state, t)
+            agg = shard_reduce(tier2_fn, ests, S, f2,
+                               plan=self.shardings)
+            new_state = self._aggregate_impl(state, None, t, agg=agg)
+            bad = (bads.any() if self._check_attack_nan
+                   else jnp.asarray(False))
+            return new_state, bad
+
+        def fused(state, t, batches=None):
+            # `batches` mirrors the flat signature (run_round always
+            # passes it); hierarchical is device-resident-only, so it
+            # is always None (validated at init).
+            new_state, bad = hier_core(state, t)
+            return new_state, {}, bad, {}
+
+        def fused_span(state, t0, count):
+            # Same traced-count fori_loop as the flat span: one
+            # compilation covers every span length.
+            def body(i, carry):
+                s, bad = carry
+                s2, b = hier_core(s, t0 + i)
+                if self._check_attack_nan:
+                    bad = bad | b
+                return s2, bad
+
+            return jax.lax.fori_loop(0, count, body,
+                                     (state, jnp.asarray(False)))
+
+        donate = self._donate_kw()
+        self._fused_round = jax.jit(fused, **donate)
+        self._fused_span = jax.jit(fused_span, **donate)
+        self._staged = False
+
+    # ------------------------------------------------------------------
     def cost_report(self, logger=None, span: Optional[int] = None):
         """Static compile-and-cost facts for every jitted entry point
         this engine built (utils/costs.py): each is lowered and
@@ -828,15 +1041,21 @@ class FederatedExperiment:
             batches = None
 
         entries = []
+        # Hierarchical engines expose the same two jitted entry points
+        # under their own ledger names — the perf gate pins hier_round's
+        # peak-proxy bytes to the megabatch, not the cohort.
+        hier = cfg.aggregation == "hierarchical"
+        round_name, span_name = (("hier_round", "hier_span") if hier
+                                 else ("fused_round", "fused_span"))
         if not self._staged:
             if self.faults is None:
-                entries.append(("fused_round", lambda: self._fused_round
+                entries.append((round_name, lambda: self._fused_round
                                 .lower(self.state, t0, batches)))
                 if not self._streaming:
                     # Span length is a traced operand: one compilation
                     # covers every span, so one analysis does too.
                     entries.append(
-                        ("fused_span", lambda: self._fused_span.lower(
+                        (span_name, lambda: self._fused_span.lower(
                             self.state, t0,
                             jnp.asarray(span_len, jnp.int32))))
                     if cfg.telemetry:
@@ -873,15 +1092,30 @@ class FederatedExperiment:
             kw["round"] = t0
         if self._needs_server_grad:
             kw["server_grad"] = jax.ShapeDtypeStruct((d,), jnp.float32)
-        grads_sds = jax.ShapeDtypeStruct((self.m, d), self._grad_dtype)
+        # Hierarchical: the tier-1 kernel only ever sees one (m, d)
+        # megabatch with the assumed per-shard bound; tier-2 gets its
+        # own ledger row over the (S, d) estimate matrix.
+        du_n, du_f = ((self._placement.megabatch, self._tier1_f) if hier
+                      else (self.m, self.m_mal))
+        grads_sds = jax.ShapeDtypeStruct((du_n, d), self._grad_dtype)
         defense_fn = self.defense_fn
 
         def defense_lowered():
             jitted = jax.jit(lambda G, **kws: defense_fn(
-                G, self.m, self.m_mal, **kws))
+                G, du_n, du_f, **kws))
             return jitted.lower(grads_sds, **kw)
 
         entries.append((f"defense_{cfg.defense}", defense_lowered))
+        if hier:
+            S = self._placement.num_shards
+            est_sds = jax.ShapeDtypeStruct((S, d), jnp.float32)
+            tier2_fn, f2 = self._tier2_fn, self._tier2_f
+
+            def tier2_lowered():
+                jitted = jax.jit(lambda E: tier2_fn(E, S, f2))
+                return jitted.lower(est_sds)
+
+            entries.append((f"tier2_{self._tier2_name}", tier2_lowered))
         entries.append(("eval", lambda: self.evaluate.lower(
             jax.ShapeDtypeStruct((d,), jnp.float32))))
 

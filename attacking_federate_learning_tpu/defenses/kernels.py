@@ -253,6 +253,11 @@ def _krum_scores(D, users_count, corrupted_count, alive=None,
         pair_alive = None
         if alive is not None:
             pair_alive = alive[None, :] & alive[:, None]
+        # Bool eye (n² i1, not f32 — 1/4 the bytes of the old distance-
+        # diagonal eye) feeding straight into the select/reduce; XLA
+        # fuses it into the masked rowsum (no standalone n² buffer in
+        # the compiled program — checked via cost facts when the
+        # distance-path eye was replaced, tests/test_distance_impl.py).
         mask = ~jnp.eye(n, dtype=bool) if pair_alive is None else (
             pair_alive & ~jnp.eye(n, dtype=bool))
         rowsum = jnp.sum(jnp.where(mask, D, 0.0), axis=1)
@@ -782,6 +787,90 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
         return agg
     return agg, _bulyan_diag(n, selected, Dm, users_count, corrupted_count,
                              paper_scoring, method)
+
+
+# --- tier-2 (cross-shard) entries for hierarchical aggregation ----------
+#
+# The two-tier engine (ops/federated.py, core/engine.py
+# aggregation='hierarchical') reduces per-megabatch tier-1 estimates with
+# a SECOND robust pass over the (n/m, d) shard-estimate matrix.  Each
+# shard_* entry is the corresponding flat kernel re-surfaced on that
+# matrix: rows are shard estimates, ``shard_count`` plays users_count,
+# ``corrupted_shards`` is the assumed number of colluder-controlled
+# shards, and ``alive_counts`` (S,) int — the per-shard effective cohort
+# from PR 2's fault masks — maps onto the kernels' existing quarantine
+# ``mask=`` seam (a fully-dead shard's estimate can never win selection
+# or touch a trim).  No new estimator math: the mask-aware paths are
+# reused unchanged, which is what keeps tier-2 oracle-verified for free.
+
+def _alive_to_mask(alive_counts):
+    return None if alive_counts is None else alive_counts > 0
+
+
+def shard_mean(shard_estimates, shard_count, corrupted_shards,
+               alive_counts=None):
+    """Tier-2 NoDefense: alive-count-weighted mean of the shard
+    estimates — with equal megabatches and no faults this is exactly
+    the flat FedAvg mean (each estimate already averages m clients);
+    with faults the weights restore the flat masked mean's
+    per-client weighting."""
+    del corrupted_shards
+    if alive_counts is None:
+        return jnp.mean(shard_estimates, axis=0)
+    w = alive_counts.astype(jnp.float32)
+    return (w @ shard_estimates) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def shard_krum(shard_estimates, shard_count, corrupted_shards,
+               alive_counts=None, **kw):
+    """Tier-2 Krum over shard estimates (mask-aware via alive counts)."""
+    return krum(shard_estimates, shard_count, corrupted_shards,
+                mask=_alive_to_mask(alive_counts), **kw)
+
+
+def shard_trimmed_mean(shard_estimates, shard_count, corrupted_shards,
+                       alive_counts=None, **kw):
+    """Tier-2 median-anchored trimmed mean over shard estimates."""
+    return trimmed_mean(shard_estimates, shard_count, corrupted_shards,
+                        mask=_alive_to_mask(alive_counts), **kw)
+
+
+def shard_bulyan(shard_estimates, shard_count, corrupted_shards,
+                 alive_counts=None, **kw):
+    """Tier-2 Bulyan over shard estimates (mask-aware via alive
+    counts); the (S, S) distance pass is tiny — S = n/m shards."""
+    return bulyan(shard_estimates, shard_count, corrupted_shards,
+                  mask=_alive_to_mask(alive_counts), **kw)
+
+
+def shard_median(shard_estimates, shard_count, corrupted_shards,
+                 alive_counts=None, **kw):
+    """Tier-2 coordinate-wise median over shard estimates."""
+    # Local import: defenses/median.py imports DEFENSES from this module.
+    from attacking_federate_learning_tpu.defenses.median import median
+    return median(shard_estimates, shard_count, corrupted_shards,
+                  mask=_alive_to_mask(alive_counts), **kw)
+
+
+# Tier-2 dispatch surface (config.tier2_defense); tier-1 for the
+# hierarchical engine is restricted to the same names — the mask-aware,
+# oracle-verified kernel set.
+TIER2_DEFENSES = {"NoDefense": shard_mean, "Krum": shard_krum,
+                  "TrimmedMean": shard_trimmed_mean,
+                  "Bulyan": shard_bulyan, "Median": shard_median}
+
+
+def check_tier2_args(name, shard_count, corrupted_shards):
+    """Fail-fast validity for the tier-2 reduction: the Krum/Bulyan
+    bounds via :func:`check_defense_args`, plus the trimmed mean's
+    keep-count floor (S - f2 - 1 >= 1) that the flat path never hits
+    because n >> f."""
+    check_defense_args(name, shard_count, corrupted_shards)
+    if (name in ("TrimmedMean",)
+            and shard_count - corrupted_shards - 1 < 1):
+        raise ValueError(
+            f"tier-2 TrimmedMean keeps shard_count - corrupted_shards - 1 "
+            f"estimates; got S={shard_count}, f2={corrupted_shards}")
 
 
 def check_defense_args(name, users_count, corrupted_count):

@@ -1,0 +1,219 @@
+"""Explicit federated primitives: broadcast / client-map / shard-reduce.
+
+The flat engine materializes the full (n, d) gradient matrix every round
+and (for Krum/Bulyan) an (n, n) distance matrix on top — the O(n·d) /
+O(n²·d) memory wall that caps the client axis around n≈10k (at n=1M the
+gradient matrix alone is ~300 TB).  DrJAX (arXiv 2403.07128) shows that
+federated computations decompose into three primitives that compose
+with sharding and scan; this module is that decomposition for the round
+engine's client axis:
+
+- :func:`broadcast` — server state to every client.  In jax this is
+  free (closure capture + XLA replication), so the primitive is an
+  annotation hook: under a MeshPlan it pins the replicated layout.
+- :func:`client_map` — apply a per-megabatch function over the client
+  axis as a ``lax.scan`` of static-size *megabatches* (m ≪ n clients at
+  a time).  Only one megabatch's gradients are ever live; XLA reuses
+  the loop carry buffers across iterations, so the round's peak memory
+  scales with m·d, not n·d (pinned by tools/perf_gate.py memproof).
+- :func:`shard_reduce` — the cross-shard reduction over the (n/m, d)
+  shard-estimate matrix (tier-2 of the two-tier robust aggregation,
+  defenses/kernels.py shard_* entries).
+
+The megabatch *placement* (which client ids land in which megabatch,
+and where the colluding malicious rows [0, f) sit) is a host-side pure
+function of the config (:func:`make_placement`).  Placement is a real
+Byzantine surface, not a systems detail (NET-SA, arXiv 2501.01187):
+colluders *concentrated* in one shard overwhelm its tier-1 estimator
+but present tier-2 with a single outlier estimate; *spread* colluders
+stay under every shard's tier-1 tolerance but tint every estimate.
+``config.mal_placement`` selects the scenario; GRID_RESULTS.md banks
+the measured flip.
+
+Attack-seam semantics under client_map (the documented change behind
+``aggregation='hierarchical'``): ``Attack.craft`` runs once per
+megabatch and sees only that megabatch's malicious rows — cohort
+statistics (ALIE's mean/std envelope) are per-megabatch, not global.
+Scan shapes must be static, so megabatches are grouped by their
+malicious-row count and one scan runs per distinct count (≤ 3 groups:
+full/partial/zero under 'concentrated', hi/lo under 'spread').
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+class Placement(NamedTuple):
+    """Host-side megabatch layout: a pure function of the config.
+
+    ``grid[s]`` lists megabatch s's client ids, malicious ids first
+    (the per-megabatch mirror of the engine's rows-[0, f) attack
+    invariant); ``mal_counts[s]`` is that static count.  ``groups``
+    pairs each distinct malicious count with the megabatch ids that
+    share it — one ``lax.scan`` per group keeps every shape static.
+    """
+
+    grid: np.ndarray                       # (S, m) int32 client ids
+    mal_counts: Tuple[int, ...]            # per-megabatch malicious rows
+    groups: Tuple[Tuple[int, Tuple[int, ...]], ...]
+    megabatch: int                         # m
+    num_shards: int                        # S = n / m
+
+
+def tier1_assumed(f: int, num_shards: int) -> int:
+    """Default per-shard corrupted bound the tier-1 estimator assumes:
+    the server doesn't know the placement, so it budgets for the
+    evenly-spread worst case, ceil(f / S)."""
+    return -(-f // num_shards) if f > 0 else 0
+
+
+def tier2_assumed(f: int, megabatch: int) -> int:
+    """Default corrupted-shard bound for tier-2: the number of shards
+    the f colluders could fill outright, ceil(f / m) (capped below by 1
+    whenever any colluder exists — one partially-filled shard can still
+    carry a poisoned estimate)."""
+    return -(-f // megabatch) if f > 0 else 0
+
+
+def make_placement(n: int, f: int, megabatch: int,
+                   mal_placement: str = "spread") -> Placement:
+    """Assign the n clients (malicious = ids [0, f)) to n/m megabatches.
+
+    'spread' deals malicious ids round-robin across megabatches
+    (counts differ by at most one); 'concentrated' packs them into the
+    fewest megabatches (the colluders-own-a-shard scenario).  Honest
+    ids fill the remaining slots in id order.  Deterministic — no RNG:
+    the placement is part of the run's identity.
+    """
+    if megabatch < 1 or n % megabatch:
+        raise ValueError(
+            f"megabatch must divide users_count (n={n}, m={megabatch})")
+    if mal_placement not in ("spread", "concentrated"):
+        raise ValueError(f"mal_placement must be 'spread' or "
+                         f"'concentrated', got {mal_placement!r}")
+    m, S = megabatch, n // megabatch
+    shards: list = [[] for _ in range(S)]
+    for k in range(f):
+        shards[k % S if mal_placement == "spread" else k // m].append(k)
+    counts = tuple(len(s) for s in shards)
+    honest = iter(range(f, n))
+    for rows in shards:
+        while len(rows) < m:
+            rows.append(next(honest))
+    grouped: dict = {}
+    for sid, c in enumerate(counts):
+        grouped.setdefault(c, []).append(sid)
+    groups = tuple((c, tuple(sids)) for c, sids in grouped.items())
+    return Placement(grid=np.asarray(shards, np.int32), mal_counts=counts,
+                     groups=groups, megabatch=m, num_shards=S)
+
+
+def broadcast(value, plan=None):
+    """Server -> clients broadcast.  Functionally the identity (the
+    scanned client_map closes over the value and XLA replicates it);
+    under a MeshPlan it additionally pins the replicated layout so the
+    broadcast operand never picks up a stray sharding from its
+    producer."""
+    if plan is None:
+        return value
+    from jax.sharding import PartitionSpec as P
+
+    return lax.with_sharding_constraint(value, plan.sharding(P()))
+
+
+def client_map(shard_fn, placement: Placement, *args):
+    """Stream ``shard_fn`` over the client axis, one megabatch at a time.
+
+    ``shard_fn(ids, mal_count, *args) -> pytree`` receives a traced
+    (m,) int32 id vector and its megabatch's STATIC malicious-row
+    count; ``*args`` are broadcast operands (server state, round
+    index).  Returns the per-megabatch pytrees stacked along a leading
+    shard axis, in megabatch order — the (n/m, ...) shard-estimate
+    matrix.  One ``lax.scan`` per placement group (distinct malicious
+    count), so only one megabatch's intermediates are live at a time.
+    """
+    pieces, order = [], []
+    for count, sids in placement.groups:
+        grid = jnp.asarray(placement.grid[list(sids)])
+
+        def body(carry, ids, _c=count):
+            return carry, shard_fn(ids, _c, *args)
+
+        _, stacked = lax.scan(body, jnp.zeros((), jnp.int32), grid)
+        pieces.append(stacked)
+        order.extend(sids)
+    out = (pieces[0] if len(pieces) == 1
+           else jax.tree_util.tree_map(
+               lambda *xs: jnp.concatenate(xs, axis=0), *pieces))
+    if order == sorted(order):
+        return out
+    inv = jnp.asarray(np.argsort(np.asarray(order)))
+    return jax.tree_util.tree_map(lambda a: a[inv], out)
+
+
+def shard_reduce(tier2_fn, estimates, num_shards: int,
+                 corrupted_shards: int, alive_counts=None, plan=None,
+                 **kw):
+    """Cross-shard (tier-2) robust reduction over the (n/m, d)
+    shard-estimate matrix.
+
+    ``tier2_fn`` is a defenses/kernels.py ``shard_*`` entry (or any
+    ``(G, n, f, alive_counts=None) -> (d,)`` reducer);
+    ``alive_counts`` (S,) carries each shard's effective cohort from
+    the fault masks — a fully-dead shard's estimate is excluded.
+    Under a MeshPlan the estimate matrix is constrained to the
+    clients-axis layout first so the reduction's collectives are
+    explicit."""
+    estimates = estimates.astype(jnp.float32)
+    if plan is not None:
+        estimates = plan.constrain_estimates(estimates)
+    return tier2_fn(estimates, num_shards, corrupted_shards,
+                    alive_counts=alive_counts, **kw)
+
+
+def two_tier_aggregate(users_grads, placement: Placement, tier1_fn,
+                       tier2_fn, tier1_corrupted: int,
+                       tier2_corrupted: int, mask=None, plan=None):
+    """Reference two-tier aggregation over a MATERIALIZED (n, d) matrix.
+
+    The engine's hierarchical round never builds this matrix (gradients
+    are computed inside client_map); this helper exists for the places
+    that already hold one — kernel-level tests (each tier-1 estimate
+    must bit-match the flat kernel on that shard's rows) and the
+    aggregation-only benchmarks.  ``mask`` (n,) is the quarantine seam:
+    each megabatch's tier-1 runs mask-aware over its rows and tier-2
+    receives the per-shard alive counts.
+    """
+    m = placement.megabatch
+
+    def shard_fn(ids, _c, G, gmask):
+        rows = G[ids]
+        if gmask is None:
+            return tier1_fn(rows, m, tier1_corrupted).astype(jnp.float32)
+        sm = gmask[ids]
+        est = tier1_fn(rows, m, tier1_corrupted, mask=sm)
+        return est.astype(jnp.float32), jnp.sum(sm).astype(jnp.int32)
+
+    out = client_map(shard_fn, placement, users_grads, mask)
+    if mask is None:
+        estimates, alive = out, None
+    else:
+        estimates, alive = out
+    return shard_reduce(tier2_fn, estimates, placement.num_shards,
+                        tier2_corrupted, alive_counts=alive, plan=plan)
+
+
+# Megabatch sizing helper for callers that only know n (bench, docs):
+# the largest power-of-two megabatch ≤ cap that divides n.
+def auto_megabatch(n: int, cap: int = 512) -> Optional[int]:
+    for m in (2 ** k for k in range(int(math.log2(max(cap, 1))), -1, -1)):
+        if m <= cap and n % m == 0 and n // m >= 2:
+            return m
+    return None

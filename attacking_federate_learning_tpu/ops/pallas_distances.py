@@ -25,6 +25,8 @@ from jax.experimental import pallas as pl
 # Importable without TPU hardware; interpret=True runs the same kernel on CPU.
 from jax.experimental.pallas import tpu as pltpu
 
+from attacking_federate_learning_tpu.ops.distances import zero_diagonal
+
 
 def _dist_kernel(nk, gi_ref, gj_ref, sqi_ref, sqj_ref, out_ref, acc_ref):
     k = pl.program_id(2)
@@ -73,7 +75,13 @@ def pallas_pairwise_distances(G, bm=128, bn=128, bk=512, interpret=None):
     # max() pad would leave output tiles unwritten when bm != bn.
     Gp = _pad_to(_pad_to(G, 1, bk), 0, math.lcm(bm, bn))
     np_, dp = Gp.shape
-    sq = jnp.sum(Gp.astype(jnp.float32) * Gp.astype(jnp.float32), axis=1)
+    # One hoisted f32 view feeds the squared norms; the matmul operand
+    # stays Gp (bf16 rides the MXU natively), so at most one f32 cast of
+    # the padded matrix exists in the program (pinned by
+    # tests/test_distance_impl.py — a second materialization would show
+    # up as ~np*dp*4 extra temp bytes).
+    Gf = Gp.astype(jnp.float32)
+    sq = jnp.sum(Gf * Gf, axis=1)
     sq_col = sq[:, None]                      # (np, 1) row norms
     sq_row = sq[None, :]                      # (1, np) col norms
     nk = dp // bk
@@ -96,4 +104,6 @@ def pallas_pairwise_distances(G, bm=128, bn=128, bk=512, interpret=None):
         interpret=interpret,
     )(Gp, Gp, sq_col, sq_row)
     D = D[:n, :n]
-    return D * (1.0 - jnp.eye(n, dtype=D.dtype))
+    # Iota-select diagonal zeroing (ops/distances.py:zero_diagonal):
+    # the eye spelling would materialize a second (n, n) f32 buffer.
+    return zero_diagonal(D)

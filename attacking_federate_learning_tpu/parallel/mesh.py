@@ -87,6 +87,26 @@ class MeshPlan(NamedTuple):
         return jax.lax.with_sharding_constraint(
             grads, self.sharding(self.grads_spec(grads.shape[-1])))
 
+    # --- hierarchical (megabatch) composition --------------------------
+    # The two-tier engine (ops/federated.py) streams the client axis as
+    # lax.scan megabatches; inside the scan each (m, d) megabatch
+    # gradient matrix carries the SAME ('clients', model) layout as the
+    # flat (n, d) matrix — the scan axis replaces n, the mesh axes are
+    # untouched, so constrain_grads composes unchanged (GSPMD pads an
+    # uneven m over the clients axis the same way it pads n).  The
+    # (n/m, d) shard-estimate matrix rides the clients axis only when
+    # the shard count divides it; otherwise it replicates (S is small —
+    # the tier-2 pass is cheap either way).
+
+    def estimates_spec(self, num_shards: int, d: int):
+        clients = (CLIENTS if num_shards % self.mesh.shape[CLIENTS] == 0
+                   else None)
+        return P(clients, self._model_axis_or_none(d))
+
+    def constrain_estimates(self, estimates):
+        return jax.lax.with_sharding_constraint(
+            estimates, self.sharding(self.estimates_spec(*estimates.shape)))
+
 
 def make_plan(mesh_shape=None, devices=None) -> MeshPlan:
     return MeshPlan(mesh=make_mesh(mesh_shape, devices))

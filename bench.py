@@ -472,11 +472,12 @@ def main():
     RESULT["env"] = {"jax": jax.__version__,
                      "platform": dev.platform,
                      "device_kind": dev.device_kind}
-    # Accel phases sum to 4980 s, CPU phases to 3240 s; keep the same
-    # class of slack above each so a slow-but-progressing run is never
-    # cut (the measured CPU fallback takes ~1,000 s; 3600 covers a
-    # contended box without weakening the hang escape hatch).
-    deadline_timer = arm_final_deadline(5700 if on_accel else 3600)
+    # Accel phases sum to 4980 s, CPU phases to 3840 s (the two-tier
+    # hierarchy north star added 600); keep the same class of slack
+    # above each so a slow-but-progressing run is never cut (the
+    # measured CPU fallback takes ~1,100 s; 4200 covers a contended box
+    # without weakening the hang escape hatch).
+    deadline_timer = arm_final_deadline(5700 if on_accel else 4200)
     n = N_CLIENTS if on_accel else 512  # keep the CPU fallback tractable
     f = int(F_FRAC * n)
     recap(f"device: {dev.platform} ({dev.device_kind}); n={n} d={DIM} f={f}")
@@ -640,6 +641,8 @@ def main():
         # native incremental selection (native/bulyan_select.cpp) makes
         # reference-exact q=1 Bulyan O(n^2) total — minutes, not hours,
         # on one core (vs ~6 h extrapolated for the rescore loop).
+        G10h = None
+        s_b1 = None
         with phase("north-star-bulyan-exact-host", 900):
             from attacking_federate_learning_tpu.defenses.host import (
                 host_bulyan
@@ -675,6 +678,49 @@ def main():
                 s_mdh = time.perf_counter() - t0
                 recap(f"north-star: median[host native] @ {N_NORTH}: "
                       f"{s_mdh:.1f} s")
+        # Two-tier hierarchy north star (ISSUE 6): the SAME exact
+        # native Bulyan kernel, restructured as n/m per-megabatch
+        # tier-1 passes + one tier-2 pass over the (n/m, d) estimates
+        # (ops/federated.py placement).  Distance work drops n/m-fold
+        # (16.7 TFLOP -> 0.87 TFLOP at m=512), which is the whole
+        # argument for the hierarchical engine — target: beat the
+        # flat exact-Bulyan 104.5 s BASELINE.md north star measured
+        # above, on the same matrix, same box.
+        with phase("hierarchy-north-star", 600):
+            if G10h is None:
+                recap("north-star: two-tier hierarchy SKIPPED "
+                      "(native kernel unavailable)")
+            else:
+                from attacking_federate_learning_tpu.defenses.host import (
+                    host_bulyan as _host_bulyan
+                )
+                from attacking_federate_learning_tpu.ops.federated import (
+                    make_placement, tier1_assumed
+                )
+                m_mb = 512
+                pl = make_placement(N_NORTH, f10, m_mb, "spread")
+                S = pl.num_shards
+                f1 = tier1_assumed(f10, S)    # ceil(f/S): 123 @ 0.24
+                # Largest tier-2 bound Bulyan's S >= 4*f2 + 3 admits at
+                # S=20 shards (ceil(f/m)=5 would need 23 shards).
+                f2 = (S - 3) // 4
+                t0 = time.perf_counter()
+                ests = np.empty((S, DIM), np.float32)
+                for s in range(S):
+                    ests[s] = _host_bulyan(G10h[pl.grid[s]], m_mb, f1)
+                _host_bulyan(ests, S, f2)
+                s_hier = time.perf_counter() - t0
+                vs = (f" ({s_b1 / s_hier:.1f}x vs flat exact "
+                      f"{s_b1:.1f} s)" if s_b1 else "")
+                recap(f"north-star: bulyan[two-tier hierarchy, host "
+                      f"native] @ {N_NORTH}, megabatch {m_mb} "
+                      f"(S={S}, f1={f1}, f2={f2}): {s_hier:.1f} s{vs}")
+                RESULT["hierarchy"] = {
+                    "clients": N_NORTH, "megabatch": m_mb,
+                    "num_shards": S, "tier1_f": f1, "tier2_f": f2,
+                    "two_tier_bulyan_s": round(s_hier, 1),
+                    "flat_exact_bulyan_s": (round(s_b1, 1)
+                                            if s_b1 else None)}
                 del G10h
         # Hybrid-path cost model, CPU side (VERDICT r3 #2): what the
         # bulyan[selection_impl='host'] pure_callback pays to marshal

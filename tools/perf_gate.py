@@ -48,7 +48,11 @@ BASELINE = os.path.join(os.path.dirname(os.path.dirname(
 # The pinned cells: small enough to compile in CI time on CPU, wide
 # enough to cover the cost-relevant program families — the O(n^2 d)
 # distance defenses, the coordinate-wise sorts, the fused-vs-telemetry
-# round programs, and the plain mean.
+# round programs, the plain mean, and the hierarchical (two-tier)
+# streaming rounds (entry points hier_round/hier_span/tier2_*;
+# core/engine.py aggregation='hierarchical').  Hierarchical cells
+# override the base topology so both placement groups and the tier
+# validity bounds (Bulyan m >= 4*f1+3) are exercised.
 CELLS = {
     "nodefense": dict(defense="NoDefense"),
     "krum": dict(defense="Krum"),
@@ -56,6 +60,11 @@ CELLS = {
     "bulyan": dict(defense="Bulyan"),
     "median": dict(defense="Median"),
     "krum_telemetry": dict(defense="Krum", telemetry=True),
+    "hier_krum": dict(defense="Krum", aggregation="hierarchical",
+                      users_count=12, mal_prop=0.25, megabatch=4),
+    "hier_bulyan": dict(defense="Bulyan", aggregation="hierarchical",
+                        users_count=24, mal_prop=0.125, megabatch=8,
+                        tier2_defense="TrimmedMean"),
 }
 
 EXACT = ("flops", "bytes_accessed", "argument_bytes", "output_bytes")
@@ -87,10 +96,12 @@ def measure_cell(name: str, overrides: dict) -> dict:
     )
     from attacking_federate_learning_tpu.data.datasets import load_dataset
 
-    cfg = ExperimentConfig(
+    base = dict(
         dataset=C.SYNTH_MNIST, users_count=11, mal_prop=0.2,
         batch_size=16, epochs=5, test_step=5, seed=0,
-        synth_train=256, synth_test=64, **overrides)
+        synth_train=256, synth_test=64)
+    base.update(overrides)   # hierarchical cells override the topology
+    cfg = ExperimentConfig(**base)
     ds = load_dataset(cfg.dataset, seed=0, synth_train=256, synth_test=64)
     exp = FederatedExperiment(cfg, attacker=DriftAttack(1.5), dataset=ds)
     ledger = exp.cost_report()
@@ -98,6 +109,78 @@ def measure_cell(name: str, overrides: dict) -> dict:
         msgs = "; ".join(f"{n}: {m}" for n, m in ledger.errors)
         raise RuntimeError(f"cell {name}: cost analysis failed ({msgs})")
     return ledger.summary()
+
+
+# --- hierarchical memory proof (ISSUE 6 acceptance) --------------------
+# Static, deterministic, baseline-free: at the 10k north star
+# (n=10,240, d=79,510, m=512) the hierarchical round's peak-proxy bytes
+# must be bounded by the MEGABATCH, not the cohort — the (n, d) gradient
+# matrix (3.26 GB) and the (n, n) distance matrix (419 MB) must not
+# exist in the program.  Two independent witnesses: the lowered HLO text
+# contains no tensor of either shape, and memory_analysis' temp bytes
+# stay under MEM_FACTOR * m * d * 4 (measured ~2.6x — scan double
+# buffers + the per-megabatch distance/sort intermediates; 6x leaves
+# scheduling slack while sitting 8x below the (n, d) wall).
+
+MEMPROOF = dict(n=10_240, d=79_510, m=512, mem_factor=6.0)
+
+
+def memproof() -> int:
+    """Build the north-star hierarchical config, lower + compile ONE
+    round, and gate its static memory facts.  Returns 0 clean, 1 on a
+    violation.  No baseline: the bound is absolute (O(m*d)), so it
+    cannot drift silently with --update."""
+    import jax.numpy as jnp
+
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+    from attacking_federate_learning_tpu.utils.costs import (
+        compiled_cost_facts
+    )
+
+    n, m = MEMPROOF["n"], MEMPROOF["m"]
+    cfg = ExperimentConfig(
+        dataset=C.SYNTH_MNIST, users_count=n, mal_prop=0.24,
+        batch_size=1, epochs=5, test_step=5, seed=0, synth_train=n,
+        synth_test=64, defense="Bulyan", aggregation="hierarchical",
+        megabatch=m, tier2_defense="Bulyan", tier2_corrupted=4)
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=n, synth_test=64)
+    exp = FederatedExperiment(cfg, dataset=ds)
+    d = exp.flat.dim
+    assert d == MEMPROOF["d"], f"wire dim moved: {d}"
+    lowered = exp._fused_round.lower(exp.state, jnp.asarray(0, jnp.int32),
+                                     None)
+    text = lowered.as_text()
+    problems = []
+    for shape in (f"f32[{n},{d}]", f"bf16[{n},{d}]", f"f32[{n},{n}]"):
+        if shape in text:
+            problems.append(f"memproof: {shape} tensor present in the "
+                            f"hierarchical round HLO — the cohort-sized "
+                            f"array is back")
+    facts = compiled_cost_facts(lowered.compile())
+    bound = MEMPROOF["mem_factor"] * m * d * 4
+    for metric in ("temp_bytes",):
+        got = facts[metric]
+        if got > bound:
+            problems.append(
+                f"memproof: {metric}={got / 1e6:.0f} MB exceeds the "
+                f"O(m*d) bound {bound / 1e6:.0f} MB "
+                f"({MEMPROOF['mem_factor']}x megabatch)")
+    if problems:
+        print(f"FAIL perf_gate --memproof: {len(problems)} violation(s)")
+        for prob in problems:
+            print(f"  {prob}")
+        return 1
+    print(f"ok   perf_gate memproof: hier_round @ n={n}, m={m}, d={d}: "
+          f"temp={facts['temp_bytes'] / 1e6:.0f} MB <= "
+          f"{bound / 1e6:.0f} MB (vs (n,d)={n * d * 4 / 1e6:.0f} MB); "
+          f"no (n,d)/(n,n) tensor in the HLO; "
+          f"flops={facts['flops']:.3e}")
+    return 0
 
 
 def measure(cells) -> dict:
@@ -168,6 +251,11 @@ def main(argv=None) -> int:
     p.add_argument("--strict-env", action="store_true",
                    help="treat a baseline/environment mismatch as a "
                         "failure instead of a skip")
+    p.add_argument("--memproof", action="store_true",
+                   help="additionally run the hierarchical O(m*d) "
+                        "memory proof at the 10k north star (absolute "
+                        "bound, no baseline; ~15 s — tools/smoke.sh "
+                        "leg 7 runs it)")
     args = p.parse_args(argv)
 
     cells = [c.strip() for c in args.cells.split(",") if c.strip()]
@@ -187,7 +275,7 @@ def main(argv=None) -> int:
         print(f"wrote {args.baseline} "
               f"({sum(len(v) for v in measured.values())} entry points, "
               f"jax {env['jax']}, {env['platform']})")
-        return 0
+        return memproof() if args.memproof else 0
 
     if not os.path.exists(args.baseline):
         print(f"no baseline at {args.baseline}; run with --update first")
@@ -217,7 +305,7 @@ def main(argv=None) -> int:
     print(f"ok   perf_gate: {len(cells)} cells, {n} entry points match "
           f"the baseline (FLOPs/bytes exact, memory within "
           f"{100 * args.tolerance:.0f}%)")
-    return 0
+    return memproof() if args.memproof else 0
 
 
 if __name__ == "__main__":

@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
 # One-liner CI smoke: event-schema validation + fault matrix + crash
-# matrix + perf gate + science gate + registry selfcheck.
+# matrix + perf gate (incl. hierarchical memproof) + science gate +
+# registry selfcheck + hierarchical-aggregation smoke.
 #
-#   bash tools/smoke.sh            # all six, CPU-pinned
+#   bash tools/smoke.sh            # all seven, CPU-pinned
 #   bash tools/smoke.sh --fast     # skip the fault + crash matrices
 #                                  # (the two slowest legs)
 #
@@ -23,7 +24,11 @@
 #      ulp-tie bands elsewhere);
 #   6. 'runs selfcheck' — cross-run registry over runs/ (incl. the
 #      supervised-run artifacts legs 2-3 leave behind): index refresh
-#      idempotence + every entry resolvable (utils/registry.py).
+#      idempotence + every entry resolvable (utils/registry.py);
+#   7. hierarchical-aggregation smoke — a 5-round journaled
+#      hierarchical x {Krum, TrimmedMean} run each (two-tier streaming
+#      engine, ops/federated.py), then a journal audit: every round and
+#      eval committed exactly once (utils/lifecycle.py RunJournal).
 #
 # Exit: nonzero if any leg fails.  Always CPU (the gates' baselines are
 # CPU artifacts, and the matrices must not touch a TPU capture).
@@ -38,32 +43,32 @@ fail=0
 shopt -s nullglob
 jsonls=(logs/*.jsonl)
 if [ ${#jsonls[@]} -gt 0 ]; then
-    echo "== smoke 1/6: check_events (${#jsonls[@]} logs) =="
+    echo "== smoke 1/7: check_events (${#jsonls[@]} logs) =="
     python tools/check_events.py "${jsonls[@]}" || fail=1
 else
-    echo "== smoke 1/6: check_events — no logs/*.jsonl yet, skipped =="
+    echo "== smoke 1/7: check_events — no logs/*.jsonl yet, skipped =="
 fi
 
 crash_work=""
 if [ "${1:-}" != "--fast" ]; then
-    echo "== smoke 2/6: fault_matrix =="
+    echo "== smoke 2/7: fault_matrix =="
     python tools/fault_matrix.py || fail=1
-    echo "== smoke 3/6: crash_matrix (supervised preempt/resume) =="
+    echo "== smoke 3/7: crash_matrix (supervised preempt/resume) =="
     # Keep the matrix's run stores: leg 6 registry-checks them.
     crash_work="$(mktemp -d -t crash_matrix_XXXXXX)"
     python tools/crash_matrix.py --workdir "$crash_work" || fail=1
 else
-    echo "== smoke 2/6: fault_matrix — skipped (--fast) =="
-    echo "== smoke 3/6: crash_matrix — skipped (--fast) =="
+    echo "== smoke 2/7: fault_matrix — skipped (--fast) =="
+    echo "== smoke 3/7: crash_matrix — skipped (--fast) =="
 fi
 
-echo "== smoke 4/6: perf_gate =="
-python tools/perf_gate.py || fail=1
+echo "== smoke 4/7: perf_gate (+ hierarchical memproof) =="
+python tools/perf_gate.py --memproof || fail=1
 
-echo "== smoke 5/6: science_gate (behavioral drift) =="
+echo "== smoke 5/7: science_gate (behavioral drift) =="
 python tools/science_gate.py || fail=1
 
-echo "== smoke 6/6: runs selfcheck (registry) =="
+echo "== smoke 6/7: runs selfcheck (registry) =="
 python -m attacking_federate_learning_tpu.cli runs selfcheck || fail=1
 if [ -n "$crash_work" ]; then
     # The registry over the crash matrix's preempt/resume artifacts:
@@ -79,6 +84,32 @@ if [ -n "$crash_work" ]; then
     done
     rm -rf "$crash_work"
 fi
+
+echo "== smoke 7/7: hierarchical aggregation (journaled, audited) =="
+hier_work="$(mktemp -d -t hier_smoke_XXXXXX)"
+for def in Krum TrimmedMean; do
+    python -m attacking_federate_learning_tpu.cli \
+        -d "$def" -s SYNTH_MNIST -n 12 -m 0.25 -c 16 -e 5 \
+        --synth-train 256 --synth-test 64 \
+        --aggregation hierarchical --megabatch 4 \
+        --journal --run-id "hier_${def}_smoke" --no-checkpoint \
+        --log-dir "$hier_work/logs" --run-dir "$hier_work/runs" \
+        > /dev/null || fail=1
+done
+# Journal audit: every round and eval committed exactly once
+# (utils/lifecycle.py RunJournal.verify returns [] when clean).
+python - "$hier_work/runs" <<'PY' || fail=1
+import sys
+from attacking_federate_learning_tpu.utils.lifecycle import RunJournal
+bad = 0
+for rid in ("hier_Krum_smoke", "hier_TrimmedMean_smoke"):
+    problems = RunJournal(sys.argv[1], rid).verify(epochs=5, test_step=5)
+    status = "ok" if not problems else f"FAIL {problems}"
+    print(f"  journal {rid}: {status}")
+    bad |= bool(problems)
+sys.exit(bad)
+PY
+rm -rf "$hier_work"
 
 if [ $fail -ne 0 ]; then
     echo "SMOKE FAILED"
