@@ -228,6 +228,18 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="F2",
                    help="assumed corrupted-shard bound for tier-2 "
                         "(default: ceil(f / megabatch))")
+    p.add_argument("--secagg", default="off",
+                   choices=["off", "vanilla", "groupwise"],
+                   help="secure-aggregation protocol layer "
+                        "(protocols/secagg.py): 'vanilla' = Bonawitz-"
+                        "style pairwise-masked cohort sum (requires -d "
+                        "NoDefense — the server sees no per-client "
+                        "rows; --fault-dropout becomes a mask-"
+                        "reconstruction round), 'groupwise' = NET-SA-"
+                        "style per-megabatch sums composed with "
+                        "--aggregation hierarchical (tier-2 robust "
+                        "kernels run over group sums via "
+                        "--tier2-defense)")
     p.add_argument("--distance-impl", default="auto",
                    choices=["auto", "xla", "pallas", "host", "ring",
                             "allgather"],
@@ -406,6 +418,7 @@ def config_from_args(args) -> ExperimentConfig:
         cclip_iters=args.cclip_iters,
         trimmed_mean_impl=args.trimmed_mean_impl,
         median_impl=args.median_impl,
+        secagg=args.secagg,
         aggregation=args.aggregation,
         megabatch=args.megabatch,
         tier2_defense=args.tier2_defense,

@@ -358,6 +358,27 @@ class ExperimentConfig:
     # watchdog and the --resume target after a kill.
     checkpoint_every: int = 0
 
+    # --- secure aggregation (protocols/secagg.py; ARCHITECTURE.md) ------
+    # Server-visibility mode for client updates:
+    #   'off'       the reference fiction — the server sees every row in
+    #               the clear (byte-identical HLO to the pre-protocol
+    #               engine, pinned by PERF_BASELINE + tests/test_secagg.py)
+    #   'vanilla'   Bonawitz-style pairwise-masked sums inside the fused
+    #               round: per-pair counter-based PRNG masks in the
+    #               uint32 bitcast domain (bit-exact cancellation), the
+    #               server sees only the masked wire + the recovered
+    #               sum.  Robust per-client defenses CANNOT run (no
+    #               rows to defend over) — NoDefense is required, and a
+    #               --fault-dropout round becomes a mask-reconstruction
+    #               round (simulated seed-reveal, exact sum recovery).
+    #   'groupwise' NET-SA-style group-wise secagg composed with
+    #               aggregation='hierarchical': each megabatch's sum is
+    #               secure-aggregated (masks within the group, keyed on
+    #               global client ids) and the server sees per-GROUP
+    #               sums — tier-2 robust kernels (--tier2-defense) run
+    #               over the (n/m, d) group-sum matrix.
+    secagg: str = "off"
+
     # --- observability --------------------------------------------------
     # Per-round structured diagnostics (gradient-norm stats, aggregate
     # norm, faded lr) written to the JSONL log.  The reference logs only
@@ -484,6 +505,70 @@ class ExperimentConfig:
             raise ValueError(
                 f"checkpoint_every must be >= 0, got "
                 f"{self.checkpoint_every}")
+        if self.secagg not in ("off", "vanilla", "groupwise"):
+            raise ValueError(
+                f"--secagg must be 'off', 'vanilla' or 'groupwise', "
+                f"got {self.secagg!r}")
+        if self.secagg != "off":
+            # Secure aggregation inverts the server's visibility: every
+            # feature that reads per-client rows server-side is
+            # structurally impossible and rejected here, loudly, with
+            # the offending flag named (tests/test_secagg.py pins the
+            # message contract).
+            if self.defense != "NoDefense":
+                hint = ("use --secagg groupwise with --tier2-defense to "
+                        "defend over per-group sums"
+                        if self.secagg == "vanilla" else
+                        "move the robust kernel to --tier2-defense (it "
+                        "runs over the per-group sums)")
+                raise ValueError(
+                    f"--secagg {self.secagg}: defense {self.defense!r} "
+                    f"cannot run — the server never sees per-client "
+                    f"updates, so there are no rows to defend over; "
+                    f"set -d NoDefense ({hint})")
+            if self.secagg == "vanilla" and self.aggregation != "flat":
+                raise ValueError(
+                    "--secagg vanilla masks the whole cohort into one "
+                    "sum and requires --aggregation flat; use --secagg "
+                    "groupwise for the hierarchical composition")
+            if self.secagg == "groupwise" and self.aggregation != (
+                    "hierarchical"):
+                raise ValueError(
+                    "--secagg groupwise exposes per-megabatch sums and "
+                    "requires --aggregation hierarchical (+ --megabatch)")
+            if self.telemetry:
+                raise ValueError(
+                    "--telemetry is server-side per-client forensics "
+                    "(selection masks, per-row norms); under --secagg "
+                    "the server sees no per-client rows")
+            if self.log_round_stats:
+                raise ValueError(
+                    "--round-stats reads per-client gradient norms "
+                    "server-side; under --secagg the server sees no "
+                    "per-client rows")
+            if self.backdoor and not self.backdoor_fused:
+                raise ValueError(
+                    "--backdoor-staged crafts on the host between "
+                    "compute and aggregation; --secagg masks inside "
+                    "the fused round program (drop --backdoor-staged)")
+            if self.participation < 1.0:
+                raise ValueError(
+                    "--secagg requires --participation 1.0: pairwise "
+                    "masks are keyed on client identity, and partial "
+                    "cohorts re-key every row each round")
+            if self.grad_dtype != "float32":
+                raise ValueError(
+                    f"--secagg masks in the uint32 bitcast domain of "
+                    f"f32 wire updates; grad_dtype={self.grad_dtype!r} "
+                    f"is not maskable (set grad_dtype='float32')")
+            if self.faults is not None and (self.faults.straggler > 0
+                                            or self.faults.corrupt > 0):
+                raise ValueError(
+                    "--secagg composes only with --fault-dropout "
+                    "(dropout is the secure-aggregation protocol "
+                    "event: a mask-reconstruction round); "
+                    "--fault-straggler/--fault-corrupt mutate the "
+                    "masked wire, which the protocol cannot model yet")
         if self.local_steps < 1:
             raise ValueError(
                 f"local_steps must be >= 1, got {self.local_steps}")

@@ -104,6 +104,24 @@ class FederatedExperiment:
                     f"participation={cfg.participation})")
         else:
             self.m, self.m_mal = self.n, self.f
+        # Secure-aggregation protocol layer (protocols/secagg.py;
+        # cfg.secagg): 'off' is the reference fiction and leaves the
+        # compiled round byte-identical (pinned).  The structural
+        # incompatibilities are rejected at config construction
+        # (config.py); the one engine-level fact — a non-fusable
+        # attacker handed in programmatically — is checked here.
+        if cfg.secagg != "off":
+            from attacking_federate_learning_tpu.protocols.secagg import (
+                secagg_key
+            )
+            if not getattr(self.attacker, "fusable", True):
+                raise ValueError(
+                    "--secagg masks inside the fused round program and "
+                    "needs a fusable attack (drop --backdoor-staged)")
+            self._secagg = cfg.secagg
+            self._secagg_key = secagg_key(cfg)
+        else:
+            self._secagg = None
         # The defense only ever sees the round cohort (flat), or one
         # megabatch / the shard-estimate matrix (hierarchical).
         if cfg.aggregation == "hierarchical":
@@ -700,6 +718,25 @@ class FederatedExperiment:
             tele.update(population_telemetry(grads))
             return tele
 
+        if self._secagg is not None:
+            from attacking_federate_learning_tpu.protocols.secagg import (
+                secagg_cohort
+            )
+
+            def secagg_step(agg_grads, mask, t):
+                """Vanilla secure aggregation between the quarantine
+                and the (NoDefense-only) aggregation: mask every
+                submitted row in the uint32 bitcast domain, then
+                recover + verify server-side (protocols/secagg.py).
+                The recovered matrix is bit-identical to the clear one
+                (dropped rows zeroed either way), so the downstream
+                aggregate — and the whole run — is byte-for-byte the
+                clear run's; the ``secagg_*`` stats ride the telemetry
+                plumbing into per-round 'secagg' events."""
+                return secagg_cohort(agg_grads, mask, self._secagg_key, t)
+
+            self._secagg_step = secagg_step
+
         if getattr(self.attacker, "fusable", True):
             def fused_core(state, t, batches=None, fstate=None):
                 grads = self._compute_grads_impl(state, t, batches)
@@ -717,6 +754,10 @@ class FederatedExperiment:
                     agg_grads, mask, fstate, fstats = (
                         inject_and_quarantine(grads, t, fstate))
                     tele = {**tele, **fstats}
+                if self._secagg is not None:
+                    agg_grads, sstats = self._secagg_step(agg_grads,
+                                                          mask, t)
+                    tele = {**tele, **sstats}
                 aux = {}
                 if cfg.telemetry:
                     new_state, ddiag = self._aggregate_impl(
@@ -932,11 +973,18 @@ class FederatedExperiment:
             and self.m_mal > 0
             and getattr(self.attacker, "num_std", 1) != 0)
 
+        groupwise = self._secagg == "groupwise"
+        if groupwise:
+            from attacking_federate_learning_tpu.protocols.secagg import (
+                secagg_group
+            )
+
         def shard_fn(ids, c_mal, state, t):
             """One megabatch: ids (m,) client ids (malicious first —
             the per-megabatch mirror of the rows-[0, f) invariant),
             c_mal its STATIC malicious count.  Returns the (d,) f32
-            tier-1 estimate and the megabatch's nan flag."""
+            tier-1 estimate and the megabatch's nan flag (plus, under
+            groupwise secagg, the group's bitwise sum-check verdict)."""
             shard_rows = self.shards[ids]
             idx = round_batch_indices(
                 shard_rows, t, cfg.batch_size * cfg.local_steps)
@@ -960,31 +1008,63 @@ class FederatedExperiment:
                 (~jnp.isfinite(grads[:c_mal].astype(jnp.float32))).any()
                 if (self._check_attack_nan and c_mal > 0)
                 else jnp.asarray(False))
+            if groupwise:
+                # NET-SA composition: the group's rows are secure-
+                # aggregated (masks keyed on these GLOBAL client ids,
+                # protocols/secagg.py) and the server sees only the
+                # group sum — the tier-1 "defense" is the masked mean
+                # (cfg.defense is pinned to NoDefense at config time),
+                # bit-identical to the clear tier-1 mean, so the
+                # tier-2 robust pass over group sums is byte-for-byte
+                # the plain hierarchical NoDefense tier's.
+                grads, sum_ok = secagg_group(grads, self._secagg_key,
+                                             t, ids)
+                est = self.defense_fn(grads, m, f1)
+                return est.astype(jnp.float32), bad, sum_ok
             est = self.defense_fn(grads, m, f1)
             return est.astype(jnp.float32), bad
 
         def hier_core(state, t):
-            ests, bads = client_map(shard_fn, place, state, t)
+            tele = {}
+            if groupwise:
+                ests, bads, sum_oks = client_map(shard_fn, place,
+                                                 state, t)
+                # Per-group sum norms are server-visible under
+                # group-wise secagg (each estimate is sum/m): the v5
+                # 'secagg' event's observable quantity.
+                tele = {
+                    "secagg_sum_check_ok":
+                        jnp.all(sum_oks > 0).astype(jnp.int32),
+                    "secagg_groups": jnp.asarray(S, jnp.int32),
+                    "secagg_dropped": jnp.zeros((), jnp.int32),
+                    "secagg_masks_reconstructed":
+                        jnp.zeros((), jnp.int32),
+                    "secagg_recovery": jnp.zeros((), jnp.int32),
+                    "secagg_group_sum_norms":
+                        jnp.linalg.norm(ests, axis=1) * m,
+                }
+            else:
+                ests, bads = client_map(shard_fn, place, state, t)
             agg = shard_reduce(tier2_fn, ests, S, f2,
                                plan=self.shardings)
             new_state = self._aggregate_impl(state, None, t, agg=agg)
             bad = (bads.any() if self._check_attack_nan
                    else jnp.asarray(False))
-            return new_state, bad
+            return new_state, bad, tele
 
         def fused(state, t, batches=None):
             # `batches` mirrors the flat signature (run_round always
             # passes it); hierarchical is device-resident-only, so it
             # is always None (validated at init).
-            new_state, bad = hier_core(state, t)
-            return new_state, {}, bad, {}
+            new_state, bad, tele = hier_core(state, t)
+            return new_state, {}, bad, tele
 
         def fused_span(state, t0, count):
             # Same traced-count fori_loop as the flat span: one
             # compilation covers every span length.
             def body(i, carry):
                 s, bad = carry
-                s2, b = hier_core(s, t0 + i)
+                s2, b, _ = hier_core(s, t0 + i)
                 if self._check_attack_nan:
                     bad = bad | b
                 return s2, bad
@@ -992,9 +1072,27 @@ class FederatedExperiment:
             return jax.lax.fori_loop(0, count, body,
                                      (state, jnp.asarray(False)))
 
+        def tele_span(state, t0, count):
+            # Groupwise secagg's per-round protocol stats come back
+            # stacked, exactly like the flat engine's telemetry span
+            # (static count: one compilation per distinct span length).
+            def body(carry, i):
+                s, bad = carry
+                s2, b, tele = hier_core(s, t0 + i)
+                if self._check_attack_nan:
+                    bad = bad | b
+                return (s2, bad), tele
+
+            (s, bad), stacked = jax.lax.scan(
+                body, (state, jnp.asarray(False)), jnp.arange(count))
+            return s, bad, stacked
+
         donate = self._donate_kw()
         self._fused_round = jax.jit(fused, **donate)
         self._fused_span = jax.jit(fused_span, **donate)
+        if groupwise:
+            self._tele_span = jax.jit(tele_span, static_argnums=2,
+                                      **donate)
         self._staged = False
 
     # ------------------------------------------------------------------
@@ -1262,7 +1360,11 @@ class FederatedExperiment:
                                      jnp.asarray(start, jnp.int32),
                                      int(count), self._fault_state))
                 self.last_span_telemetry = (int(start), stacked)
-            elif self.cfg.telemetry:
+            elif self.cfg.telemetry or self._secagg is not None:
+                # secagg rides the telemetry span too: its per-round
+                # protocol stats (sum-check verdicts, recovery counts)
+                # must come back stacked even with cfg.telemetry off,
+                # exactly like the fault counts do under faults.
                 self.state, bad, stacked = self._tele_span(
                     self.state, jnp.asarray(start, jnp.int32), int(count))
                 self.last_span_telemetry = (int(start), stacked)
@@ -1345,23 +1447,32 @@ class FederatedExperiment:
 
     def _emit_round_telemetry(self, logger, t, tele):
         """Write one round's telemetry (host values) as 'defense' and
-        'attack' events (cfg.telemetry) and its 'fault_*' counts as a
-        'fault' event (fault injection — emitted with or without
-        telemetry); track Krum winners for the end-of-run selection
-        histogram."""
-        defense_fields, attack_fields, fault_fields = {}, {}, {}
+        'attack' events (cfg.telemetry), its 'fault_*' counts as a
+        'fault' event and its 'secagg_*' protocol stats as a 'secagg'
+        event (both emitted with or without telemetry); track Krum
+        winners for the end-of-run selection histogram."""
+        defense_fields, attack_fields = {}, {}
+        fault_fields, secagg_fields = {}, {}
         for k, v in tele.items():
             val = _jsonable(v)
             if k.startswith("attack_"):
                 attack_fields[k[len("attack_"):]] = val
             elif k.startswith("fault_"):
                 fault_fields[k[len("fault_"):]] = int(val)
+            elif k.startswith("secagg_"):
+                # Scalar counts/flags land as ints, the groupwise
+                # sum-norm vector as a float list.
+                secagg_fields[k[len("secagg_"):]] = (
+                    int(val) if isinstance(val, float)
+                    and float(val).is_integer() else val)
             elif k.startswith("defense_"):
                 defense_fields[k[len("defense_"):]] = val
             else:
                 defense_fields[k] = val  # population stats
         if fault_fields:
             logger.record(kind="fault", round=int(t), **fault_fields)
+        if secagg_fields:
+            logger.record(kind="secagg", round=int(t), **secagg_fields)
         if not self.cfg.telemetry:
             return
         logger.record(kind="defense", round=int(t),
@@ -1543,7 +1654,8 @@ class FederatedExperiment:
                                    else (epoch // ckpt_every + 1)
                                    * ckpt_every)
                 self.run_span(epoch, boundary - epoch + 1)
-                if ((cfg.telemetry or self.faults is not None)
+                if ((cfg.telemetry or self.faults is not None
+                        or self._secagg is not None)
                         and self.last_span_telemetry is not None):
                     # ONE host fetch per eval interval: the whole stacked
                     # telemetry pytree comes over at the eval boundary.
@@ -1566,7 +1678,8 @@ class FederatedExperiment:
                     logger.record(kind="round", round=epoch,
                                   **{k: float(v) for k, v in
                                      self.last_round_stats.items()})
-                if ((cfg.telemetry or self.faults is not None)
+                if ((cfg.telemetry or self.faults is not None
+                        or self._secagg is not None)
                         and fresh(epoch)
                         and self.last_round_telemetry is not None):
                     self._emit_round_telemetry(

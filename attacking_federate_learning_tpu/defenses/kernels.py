@@ -855,6 +855,16 @@ def shard_median(shard_estimates, shard_count, corrupted_shards,
 # Tier-2 dispatch surface (config.tier2_defense); tier-1 for the
 # hierarchical engine is restricted to the same names — the mask-aware,
 # oracle-verified kernel set.
+#
+# Group-sum seam (protocols/secagg.py, cfg.secagg='groupwise'): under
+# group-wise secure aggregation the rows these kernels see are the
+# per-megabatch SUMS the protocol exposes, scaled to means (sum / m) so
+# they remain the same (S, d) estimate matrix the plain hierarchical
+# tier produces — selection (Krum/Bulyan) is scale-covariant and the
+# coordinate trims are row-wise, so no kernel changes: the only
+# difference between "tier-2 over tier-1 estimates" and "tier-2 over
+# secagg group sums" is which tensor the server was ever allowed to
+# see, which is exactly the NET-SA measurement surface.
 TIER2_DEFENSES = {"NoDefense": shard_mean, "Krum": shard_krum,
                   "TrimmedMean": shard_trimmed_mean,
                   "Bulyan": shard_bulyan, "Median": shard_median}

@@ -65,22 +65,31 @@ def run_grid(base: ExperimentConfig, defenses=None, attacks=None,
         print(json.dumps(cell), flush=True)
 
     for defense, attack in itertools.product(defenses, attacks):
-        cfg = dataclasses.replace(
-            base, defense=defense,
-            backdoor="pattern" if attack == "backdoor" else False,
-            num_std=0.0 if attack == "none" else base.num_std,
-            mal_prop=0.0 if attack == "none" else base.mal_prop)
-        # Config-hash identity (utils/lifecycle.py): the join key
-        # between a GRID row and the run registry (runs/index.jsonl).
-        run_id = run_id_for(cfg)
+        run_id = None
         try:
+            # Construction inside the try: composition rejections
+            # (defense validity bounds, and since PR 7 the secagg
+            # visibility rules — a robust defense under --secagg is a
+            # ValueError at config time) record as skipped cells
+            # instead of killing the sweep.
+            cfg = dataclasses.replace(
+                base, defense=defense,
+                backdoor="pattern" if attack == "backdoor" else False,
+                num_std=0.0 if attack == "none" else base.num_std,
+                mal_prop=0.0 if attack == "none" else base.mal_prop)
+            # Config-hash identity (utils/lifecycle.py): the join key
+            # between a GRID row and the run registry (runs/index.jsonl).
+            run_id = run_id_for(cfg)
             attacker = make_attacker(cfg, dataset=dataset,
                                      name=attack)
             exp = FederatedExperiment(cfg, attacker=attacker,
                                       dataset=dataset)
-        except ValueError as e:  # defense guard (n vs f) — record & skip
-            emit({"defense": defense, "attack": attack,
-                  "run_id": run_id, "skipped": str(e)})
+        except ValueError as e:  # composition guard — record & skip
+            cell = {"defense": defense, "attack": attack,
+                    "skipped": str(e)}
+            if run_id is not None:  # config-level rejections have no
+                cell["run_id"] = run_id  # config hash to join on
+            emit(cell)
             continue
         t0 = time.time()
         try:
@@ -118,6 +127,19 @@ def main(argv=None):
     p.add_argument("-c", "--batch_size", default=128, type=int)
     p.add_argument("--defenses", nargs="*", default=None)
     p.add_argument("--attacks", nargs="*", default=None)
+    p.add_argument("--secagg", default="off",
+                   choices=["off", "vanilla", "groupwise"],
+                   help="secure-aggregation visibility mode for every "
+                        "cell (protocols/secagg.py); incompatible "
+                        "defense cells record as skipped")
+    p.add_argument("--aggregation", default="flat",
+                   choices=["flat", "hierarchical"])
+    p.add_argument("--megabatch", default=0, type=int)
+    p.add_argument("--tier2-defense", default=None,
+                   choices=["NoDefense", "Krum", "TrimmedMean", "Bulyan",
+                            "Median"])
+    p.add_argument("--mal-placement", default="spread",
+                   choices=["spread", "concentrated"])
     p.add_argument("--seed", default=0, type=int)
     p.add_argument("--backend", default="auto",
                    choices=["auto", "cpu", "tpu"])
@@ -140,7 +162,12 @@ def main(argv=None):
                             batch_size=args.batch_size, seed=args.seed,
                             backend=args.backend, log_dir=args.log_dir,
                             synth_train=args.synth_train,
-                            synth_test=args.synth_test)
+                            synth_test=args.synth_test,
+                            secagg=args.secagg,
+                            aggregation=args.aggregation,
+                            megabatch=args.megabatch,
+                            tier2_defense=args.tier2_defense,
+                            mal_placement=args.mal_placement)
     run_grid(base, args.defenses, args.attacks, out_path=args.out)
 
 

@@ -1,0 +1,13 @@
+"""Simulated wire protocols between clients and the server.
+
+The reference simulator (and the faithful rebuild) hands the server
+every client's update in the clear; this package models the protocols a
+production deployment actually speaks on that wire.  First resident:
+:mod:`secagg` — Bonawitz-style pairwise-masked secure aggregation
+(arXiv 1611.04482), simulated *inside* the fused round program with
+bit-exact mask cancellation (core/engine.py ``cfg.secagg``).
+"""
+
+from attacking_federate_learning_tpu.protocols.secagg import (  # noqa: F401
+    SECAGG_MODES, secagg_cohort, secagg_key
+)

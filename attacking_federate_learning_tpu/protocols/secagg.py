@@ -1,0 +1,308 @@
+"""Simulated secure aggregation: pairwise masks that cancel bit-exactly.
+
+Bonawitz et al. (arXiv 1611.04482) let a federated server learn ONLY the
+sum of client updates: every pair of clients (i, j) agrees on a shared
+mask; client i adds it, client j subtracts it, and the masks cancel in
+the server's sum.  Dropped clients are handled by reconstructing their
+pairwise masks from the survivors' secret shares.  This module is that
+protocol as a pure jax computation that runs *inside* the fused round
+program (core/engine.py ``cfg.secagg``), with two deliberate
+simulation choices:
+
+**Masking lives in the uint32 bitcast domain.**  f32 addition is not
+exactly invertible (``(x + m) - m != x`` in general), so float masks
+could never cancel bit-exactly.  Instead the (d,) f32 update is
+bitcast to uint32 and masked with mod-2^32 addition, which IS exactly
+invertible and exactly associative: ``u + delta - delta == u`` for
+every bit pattern (NaN/Inf rows included), and the mod-2^32 column sum
+of masked rows equals the mod-2^32 column sum of the clear bit
+patterns — pairwise cancellation is a theorem of integer arithmetic,
+not a numerical accident.  :func:`unmask_sum` verifies that identity
+bitwise every round (``sum_check_ok``), and the per-row unmask
+reproduces the clear matrix bit-for-bit, so the protocol layer is
+behaviorally invisible: a masked run's final weights are bit-equal to
+the clear run's (tests/test_secagg.py pins it).
+
+**The optimization barrier is the network.**  Without it XLA's
+algebraic simplifier would cancel ``(u + delta) - delta`` at compile
+time and delete the protocol from the program.  The
+``lax.optimization_barrier`` on the wire tensor marks the
+client->server transfer: everything before it is client-side compute,
+everything after is what the server received, and the compiler may not
+reason across it.  The HLO consequence is checkable
+(:func:`wire_hlo_facts`): the masked u32 wire exists in the compiled
+round, and past the wire no per-client f32 (n, d) tensor is
+materialized at the top level — the server-visible program only ever
+reduces the wire (the ``perf_gate``-style structural pin).
+
+**Mask derivation is counter-based and stateless.**  The pair (i, j)
+mask for round t is ``random.bits(fold_in(fold_in(fold_in(key, t),
+min(i, j)), max(i, j)))`` with sign +1 for the lower id and -1 for the
+higher — antisymmetric by construction, derived (never stored), so a
+preempted run re-derives byte-identical masks on resume and the
+groupwise mode keys masks on GLOBAL client ids (two groups never share
+a mask stream).
+
+**Dropout is a protocol event.**  A dropped client (PR 2's fault
+harness) never submits its wire; the survivors' wires still carry the
+masks they agreed with it.  :func:`recovery_residue` re-derives every
+(survivor, dropped) pair mask — the simulated seed-reveal round — and
+the sum check then verifies ``modsum(wire[alive]) - residue ==
+modsum(clear[alive])`` bitwise: exact sum recovery, counted per round
+as ``masks_reconstructed``.
+
+What the simulation does and does not claim: privacy here is
+*structural*, not cryptographic — the server-side code path consumes
+only the wire and the sanctioned :func:`unmask_sum` output, robust
+per-client defenses are rejected at init (config.py), and the sum
+check reads the clear matrix only as a verification witness.  The
+threat-model writeup lives in ARCHITECTURE.md "Secure aggregation".
+
+Cost model: deriving the full pairwise mask stream is O(n^2 · d) PRNG
+work per round under ``vanilla`` (every pair in the cohort) and
+O(S · m^2 · d) = O(n · m · d) under ``groupwise`` (pairs within each
+megabatch only) — the same scalability argument NET-SA
+(arXiv 2501.01187) makes for in-network/group-wise aggregation.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+SECAGG_MODES = ("off", "vanilla", "groupwise")
+
+
+def secagg_key(cfg):
+    """The protocol's own key stream, derived from the experiment seed
+    (core/faults.py:fault_key precedent).  Derived, not stored: a
+    resumed run rebuilds the identical stream from the config alone."""
+    return jax.random.key(cfg.seed ^ 0x5EC466)
+
+
+def _pair_key(key_t, a, b):
+    """Counter-based key for the UNORDERED pair {a, b}: both members
+    derive the same stream (fold the lower id first)."""
+    lo, hi = jnp.minimum(a, b), jnp.maximum(a, b)
+    return jax.random.fold_in(jax.random.fold_in(key_t, lo), hi)
+
+
+def pairwise_deltas(key_t, ids, d):
+    """Per-row net mask ``delta_i = sum_j sign(i, j) * m_ij (mod 2^32)``
+    over every pair in ``ids``.
+
+    ``ids`` is an (n,) int32 id vector (``jnp.arange(n)`` for the flat
+    cohort under full participation; a megabatch's global client ids
+    under groupwise).  Sign is +1 when ``ids[i] < ids[j]`` and -1
+    otherwise, so the deltas are antisymmetric by construction and
+    ``sum_i delta_i == 0 (mod 2^32)`` exactly.  Returns (n, d) uint32.
+    """
+    n = ids.shape[0]
+
+    def row(a):
+        def body(b, acc):
+            m = jax.random.bits(_pair_key(key_t, ids[a], ids[b]), (d,),
+                                jnp.uint32)
+            signed = jnp.where(ids[a] < ids[b], m, jnp.uint32(0) - m)
+            return acc + jnp.where(a == b, jnp.uint32(0), signed)
+
+        return lax.fori_loop(0, n, body, jnp.zeros((d,), jnp.uint32))
+
+    return jax.vmap(row)(jnp.arange(n))
+
+
+def mask_rows(grads, deltas):
+    """Client side: bitcast each f32 row to uint32, add its net mask
+    mod 2^32, and ship it.  The optimization barrier IS the network:
+    the compiler may not cancel the mask against the server's unmask
+    (it would delete the protocol from the program), and everything
+    past the barrier is the server-visible computation."""
+    bits = lax.bitcast_convert_type(grads.astype(jnp.float32), jnp.uint32)
+    return lax.optimization_barrier(bits + deltas)
+
+
+def unmask_rows(wire, deltas, alive=None):
+    """The trusted-decrypt seam of the simulation: remove each
+    surviving row's net mask (exact mod-2^32 inverse) and bitcast back
+    — bit-identical to the clear submission, NaN/Inf patterns
+    included.  Dropped rows (``alive`` False) never submitted a wire
+    and come back zeroed, matching the fault quarantine's zeroing."""
+    clear = lax.bitcast_convert_type(wire - deltas, jnp.float32)
+    if alive is not None:
+        clear = jnp.where(alive[:, None], clear, 0.0)
+    return clear
+
+
+def modular_sum(bits, alive=None):
+    """Mod-2^32 column sum of uint32 rows — exactly associative, so
+    the reduction order can never matter (unlike f32 sums)."""
+    if alive is not None:
+        bits = jnp.where(alive[:, None], bits, jnp.uint32(0))
+    return jnp.sum(bits, axis=0, dtype=jnp.uint32)
+
+
+def recovery_residue(key_t, ids, alive, d):
+    """The simulated seed-reveal round: re-derive every
+    (survivor, dropped) pair mask and accumulate the net residue those
+    unpaired masks leave in the survivors' modular sum.  Returns
+    ``(residue (d,) uint32, reconstructed_pairs int32)``."""
+    n = ids.shape[0]
+
+    def outer(i, carry):
+        acc, pairs = carry
+
+        def inner(j, c2):
+            a2, p2 = c2
+            m = jax.random.bits(_pair_key(key_t, ids[i], ids[j]), (d,),
+                                jnp.uint32)
+            signed = jnp.where(ids[i] < ids[j], m, jnp.uint32(0) - m)
+            take = alive[i] & ~alive[j] & (i != j)
+            return (a2 + jnp.where(take, signed, jnp.uint32(0)),
+                    p2 + take.astype(jnp.int32))
+
+        return lax.fori_loop(0, n, inner, (acc, pairs))
+
+    return lax.fori_loop(0, n, outer,
+                         (jnp.zeros((d,), jnp.uint32),
+                          jnp.zeros((), jnp.int32)))
+
+
+def unmask_sum(wire, deltas, clear, alive, key_t, ids):
+    """Server side of the protocol round: recover the aggregable
+    matrix and verify exact sum recovery bitwise.
+
+    With everyone alive the check is pure pairwise cancellation:
+    ``modsum(wire) == modsum(clear)`` (the antisymmetric deltas sum to
+    zero mod 2^32).  With dropouts it is the full Bonawitz recovery
+    identity: ``modsum(wire[alive]) - residue == modsum(clear[alive])``
+    where the residue is rebuilt pair-by-pair from the dropped
+    clients' revealed seeds (:func:`recovery_residue`).  ``clear`` is
+    read ONLY by this verification — a simulation witness, not a
+    server capability.  Returns ``(recovered (n, d) f32, stats)`` with
+    fixed-shape ``secagg_*`` scalars that ride the engine's telemetry
+    plumbing into per-round 'secagg' events (schema v5)."""
+    clear_bits = lax.bitcast_convert_type(clear.astype(jnp.float32),
+                                          jnp.uint32)
+    if alive is None:
+        s_wire = modular_sum(wire)
+        residue = jnp.zeros_like(s_wire)
+        pairs = jnp.zeros((), jnp.int32)
+        dropped = jnp.zeros((), jnp.int32)
+        s_clear = modular_sum(clear_bits)
+    else:
+        s_wire = modular_sum(wire, alive)
+        residue, pairs = recovery_residue(key_t, ids, alive,
+                                          wire.shape[1])
+        dropped = jnp.sum(~alive).astype(jnp.int32)
+        s_clear = modular_sum(clear_bits, alive)
+    ok = jnp.all(s_wire - residue == s_clear).astype(jnp.int32)
+    recovered = unmask_rows(wire, deltas, alive)
+    stats = {
+        "secagg_sum_check_ok": ok,
+        "secagg_dropped": dropped,
+        "secagg_masks_reconstructed": pairs,
+        "secagg_recovery": (dropped > 0).astype(jnp.int32),
+    }
+    return recovered, stats
+
+
+def secagg_cohort(grads, alive, key, t, ids=None):
+    """One full protocol round over an (n, d) f32 cohort matrix:
+    derive the round-t mask stream, mask every row (clients), then
+    recover + verify (server).  ``alive`` is the quarantine mask from
+    the fault harness (None = everyone submitted); ``ids`` the global
+    client ids behind the rows (defaults to row indices — the flat
+    engine's full-participation identity).  Returns
+    ``(recovered, stats)``; ``recovered`` is bit-identical to the
+    clear matrix with dropped rows zeroed, so the downstream
+    aggregation is byte-for-byte the clear computation's."""
+    n, d = grads.shape
+    if ids is None:
+        ids = jnp.arange(n, dtype=jnp.int32)
+    key_t = jax.random.fold_in(key, t)
+    deltas = pairwise_deltas(key_t, ids, d)
+    wire = mask_rows(grads, deltas)
+    return unmask_sum(wire, deltas, grads, alive, key_t, ids)
+
+
+def secagg_group(grads, key, t, ids):
+    """Groupwise mode's per-megabatch protocol round (everyone in the
+    group submits — faults do not compose with hierarchical rounds
+    yet): masks keyed on GLOBAL client ids, recovery trivial.  Returns
+    ``(recovered, sum_check_ok int32)``."""
+    recovered, stats = secagg_cohort(grads, None, key, t, ids=ids)
+    return recovered, stats["secagg_sum_check_ok"]
+
+
+# --- structural HLO witness (the perf_gate-memproof-style pin) ----------
+
+_NAME_RE = re.compile(r"\s*(%[\w.\-]+)\s*=\s*(\w+)\[([\d,]*)\]")
+
+
+def wire_hlo_facts(hlo_text, n, d):
+    """Parse a compiled round's optimized HLO for the vanilla-secagg
+    structural facts (tests/test_secagg.py and ``tools/perf_gate.py
+    --memproof`` gate them):
+
+    - ``wire_present`` — a top-level u32 (n, d) tensor exists: the
+      masked wire really is in the program (the optimization barrier
+      kept the compiler from cancelling the protocol away);
+    - ``unmask_instructions`` / ``unmask_reduce_only`` — every
+      top-level f32 (n, d) instruction built FROM u32 (n, d) operands
+      is the server's reconstruction of the aggregable matrix (the
+      trusted-decrypt seam); the pin demands its ONLY consumers are
+      client-axis ``reduce`` instructions producing the (d,) sum — no
+      other server-side op (a defense, a sort, a per-row diagnostic)
+      may read per-client rows post-masking;
+    - ``distance_matrix`` — an f32 (n, n) tensor anywhere in the
+      program means a pairwise-distance defense ran over per-client
+      rows (must be absent under secagg).
+
+    Fusion bodies are loop-/register-local values, never
+    server-readable buffers, so the ENTRY computation is the
+    allocation-level view this check wants."""
+    wire_shape = f"u32[{n},{d}]"
+    clear_shape = f"f32[{n},{d}]"
+    entry_lines = []
+    in_entry = False
+    for line in hlo_text.splitlines():
+        if line.startswith("ENTRY "):
+            in_entry = True
+            continue
+        if in_entry:
+            if line.startswith("}"):
+                break
+            entry_lines.append(line)
+    wire_present = False
+    unmask = []
+    for line in entry_lines:
+        m = _NAME_RE.match(line)
+        if not m:
+            continue
+        shape = f"{m.group(2)}[{m.group(3)}]"
+        if shape == wire_shape:
+            wire_present = True
+        operands = line.split("=", 1)[1]
+        if shape == clear_shape and wire_shape in operands:
+            unmask.append(m.group(1))
+    reduce_only = True
+    for name in unmask:
+        for line in entry_lines:
+            m = _NAME_RE.match(line)
+            if not m or m.group(1) == name:
+                continue
+            if (name + " " in line or name + "," in line
+                    or name + ")" in line):
+                if not (" reduce(" in line
+                        and f"= f32[{d}]" in line.replace("{0}", "")):
+                    reduce_only = False
+    return {
+        "wire_present": wire_present,
+        "unmask_instructions": len(unmask),
+        "unmask_reduce_only": bool(unmask) and reduce_only,
+        "distance_matrix": f"f32[{n},{n}]" in hlo_text,
+    }

@@ -116,6 +116,33 @@ def fault_recovery(events):
             "quarantined": quarantined, "rollbacks": rollbacks}
 
 
+def secagg_summary(events):
+    """Secure-aggregation protocol rollup from 'secagg' events (schema
+    v5, protocols/secagg.py): rounds under the protocol, dropout-
+    recovery rounds and total masks reconstructed (the simulated
+    seed-reveal work), bitwise sum-check failures (must be 0 — the
+    mask-cancellation identity is exact), and under groupwise the
+    last round's per-group sum norms (the server-visible quantity).
+    Returns None when the run emitted no secagg events (secagg off)."""
+    recs = [e for e in events if e.get("kind") == "secagg"]
+    if not recs:
+        return None
+    out = {"rounds": len(recs),
+           "recovery_rounds": sum(1 for e in recs
+                                  if e.get("recovery")),
+           "masks_reconstructed": sum(
+               int(e.get("masks_reconstructed", 0)) for e in recs),
+           "sum_check_failures": sum(
+               1 for e in recs if not e.get("sum_check_ok", 1))}
+    norms = [e["group_sum_norms"] for e in recs
+             if isinstance(e.get("group_sum_norms"), list)]
+    if norms:
+        out["groups"] = len(norms[-1])
+        out["group_sum_norms_last"] = [round(float(x), 3)
+                                       for x in norms[-1]]
+    return out
+
+
 def compile_cost(events):
     """The compile & cost table ('compile'/'cost' events, schema v2 —
     utils/costs.py): per entry point, static FLOPs / bytes-accessed /
@@ -222,6 +249,9 @@ def summarize_run(events):
     faults = fault_recovery(events)
     if faults:
         out["faults"] = faults
+    sec = secagg_summary(events)
+    if sec:
+        out["secagg"] = sec
     hists = [e for e in events if e["kind"] == "selection_hist"]
     if hists:
         out["selection_hist"] = {
@@ -286,6 +316,19 @@ def _print_run(path, s, out):
         for rb in flt["rollbacks"]:
             out(f"    rollback at round {rb['round']} -> restored round "
                 f"{rb['restored_round']} (total {rb['rollbacks_total']})")
+    sec = s.get("secagg")
+    if sec:
+        line = (f"  secagg: {sec['rounds']} masked rounds, "
+                f"{sec['recovery_rounds']} recovery round(s), "
+                f"{sec['masks_reconstructed']} masks reconstructed, "
+                f"{sec['sum_check_failures']} sum-check failure(s)")
+        if "groups" in sec:
+            line += f", {sec['groups']} groups"
+        out(line)
+        if "group_sum_norms_last" in sec:
+            out("    group sum norms (last round): "
+                + "  ".join(f"{x:.3f}"
+                            for x in sec["group_sum_norms_last"]))
     cc = s.get("compile_cost")
     if cc:
         out(f"  compile & cost ({cc['compile_total_s']:.2f} s total "

@@ -8,7 +8,14 @@ the experiment flag surface stays reference-verbatim).  Verbs:
 - ``runs diff A B`` — field-by-field diff of two runs: config deltas,
   final accuracy/ASR, fault/lifecycle/cache counts, and the per-round
   trajectory divergence point (bit-identity when the shared rounds
-  match exactly — the determinism witness two same-seed runs must pass)
+  match exactly — the determinism witness two same-seed runs must
+  pass).  ``--band N`` relaxes the float comparison to an N-ulp band
+  in the float32 domain: cross-ENGINE twins (sharded vs single-device,
+  flat vs hierarchical tier-1) legally differ by ~1-ulp reduction
+  reorders that cascade through selection-mediated metrics (the PR 4
+  adjudication rationale, tests/test_distance_impl.py) — exact-float
+  compare makes those diffs all-noise, the band names only the real
+  divergences
 - ``runs compare Q...`` — side-by-side metric table over N runs
 - ``runs tag Q TAG``    — attach a resolvable human tag
 - ``runs trace Q``      — export the run's event log as Chrome/Perfetto
@@ -75,7 +82,42 @@ def _trajectory(events):
     return out
 
 
-def diff_trajectories(events_a, events_b) -> dict:
+def _f32_ord(x: float) -> int:
+    """Monotonic integer ordinal of a float in the float32 domain:
+    adjacent representable f32 values differ by exactly 1.  Event
+    floats are f32 measurements serialized through JSON f64, so the
+    f32 lattice is the native resolution of an event-log ulp."""
+    import struct
+
+    (u,) = struct.unpack("<I", struct.pack("<f", float(x)))
+    return u if u < 0x80000000 else 0x80000000 - u
+
+
+def _values_match(a, b, band: int) -> bool:
+    """Payload-field equality under an optional N-ulp float band.
+    ``band == 0`` is exact compare (the same-seed determinism bar);
+    ``band > 0`` admits numeric values within ``band`` f32 ulps
+    (NaN matches only NaN; lists compare elementwise)."""
+    if a == b:
+        return True
+    if band <= 0:
+        return False
+    num = (int, float)
+    if (isinstance(a, num) and isinstance(b, num)
+            and not isinstance(a, bool) and not isinstance(b, bool)):
+        if a != a or b != b:            # NaN never passes a == b above:
+            return a != a and b != b    # equal only when BOTH are NaN
+        try:
+            return abs(_f32_ord(a) - _f32_ord(b)) <= band
+        except (OverflowError, ValueError):
+            return False
+    if (isinstance(a, list) and isinstance(b, list)
+            and len(a) == len(b)):
+        return all(_values_match(x, y, band) for x, y in zip(a, b))
+    return False
+
+
+def diff_trajectories(events_a, events_b, band: int = 0) -> dict:
     """First-divergence analysis over two runs' per-round records.
 
     Compares the payloads of every shared (round, kind) pair in round
@@ -83,32 +125,42 @@ def diff_trajectories(events_a, events_b) -> dict:
     that differ.  ``bit_identical`` is True when every shared pair
     matches exactly — floats included, which is the right bar: the
     engine is deterministic, so two same-seed runs must reproduce to
-    the bit and any ulp wiggle is a real (if legal) program change."""
+    the bit and any ulp wiggle is a real (if legal) program change.
+    ``band`` (f32 ulps, ``runs diff --band N``) relaxes the float
+    compare for cross-engine twins whose metrics legally sit on 1-ulp
+    reduction-reorder flips; a clean banded compare reports
+    ``identical_within_band`` instead of bit-identity."""
     ta, tb = _trajectory(events_a), _trajectory(events_b)
     shared = sorted(set(ta) & set(tb))
     out = {"rounds_a": len(ta), "rounds_b": len(tb),
-           "rounds_compared": len(shared),
+           "rounds_compared": len(shared), "band_ulps": band,
            "divergence_round": None, "bit_identical": False}
     for r in shared:
         kinds = sorted(set(ta[r]) & set(tb[r]))
         for kind in kinds:
             pa, pb = ta[r][kind], tb[r][kind]
             bad = sorted(k for k in set(pa) | set(pb)
-                         if pa.get(k) != pb.get(k))
+                         if not _values_match(pa.get(k), pb.get(k),
+                                              band))
             if bad:
                 out["divergence_round"] = r
                 out["divergence_kind"] = kind
                 out["divergence_fields"] = {
                     k: [pa.get(k), pb.get(k)] for k in bad[:5]}
                 return out
-    out["bit_identical"] = bool(shared)
+    if shared and band == 0:
+        out["bit_identical"] = True
+    elif shared:
+        out["identical_within_band"] = True
     return out
 
 
-def diff_runs(reg: RunRegistry, ea: dict, eb: dict) -> dict:
+def diff_runs(reg: RunRegistry, ea: dict, eb: dict,
+              band: int = 0) -> dict:
     """Field-by-field run diff: config deltas (from the stamped
     manifests), summary-field deltas, and the trajectory divergence
-    point from the two event logs."""
+    point from the two event logs (``band``: f32-ulp tolerance for the
+    trajectory floats — see :func:`diff_trajectories`)."""
     out = {"a": ea.get("run_id"), "b": eb.get("run_id")}
     ca, cb = reg.load_config(ea), reg.load_config(eb)
     if ca is not None and cb is not None:
@@ -119,7 +171,8 @@ def diff_runs(reg: RunRegistry, ea: dict, eb: dict) -> dict:
         k: [ea.get(k), eb.get(k)]
         for k in _COMPARE_FIELDS if ea.get(k) != eb.get(k)}
     out["trajectory"] = diff_trajectories(_load_run_events(ea),
-                                          _load_run_events(eb))
+                                          _load_run_events(eb),
+                                          band=band)
     return out
 
 
@@ -147,6 +200,9 @@ def _print_diff(d, out=print):
     elif tr["bit_identical"]:
         out(f"  trajectory: BIT-IDENTICAL over {tr['rounds_compared']} "
             f"shared rounds")
+    elif tr.get("identical_within_band"):
+        out(f"  trajectory: identical within {tr['band_ulps']}-ulp band "
+            f"over {tr['rounds_compared']} shared rounds")
     elif tr["divergence_round"] is not None:
         fields = ", ".join(
             f"{k} ({_fmt(v[0])} vs {_fmt(v[1])})"
@@ -207,7 +263,7 @@ def cmd_show(reg, args):
 
 def cmd_diff(reg, args):
     d = diff_runs(reg, reg.resolve(args.a, args.filter),
-                  reg.resolve(args.b, args.filter))
+                  reg.resolve(args.b, args.filter), band=args.band)
     if args.json:
         print(json.dumps(d, default=str))
     else:
@@ -325,6 +381,11 @@ def main(argv=None) -> int:
     sp = sub.add_parser("diff", help="field-by-field diff of two runs")
     sp.add_argument("a")
     sp.add_argument("b")
+    sp.add_argument("--band", type=int, default=0, metavar="N",
+                    help="f32-ulp tolerance for trajectory floats "
+                         "(0 = exact bit compare; N > 0 admits legal "
+                         "reduction-reorder wiggle when diffing "
+                         "cross-engine twins)")
     sp.set_defaults(fn=cmd_diff)
     sp = sub.add_parser("compare", help="side-by-side metric table")
     sp.add_argument("queries", nargs="+")

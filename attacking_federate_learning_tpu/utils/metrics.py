@@ -29,10 +29,13 @@ engine, retry/degrade/exhausted from tools/supervisor.py;
 utils/lifecycle.py); v4 adds the cross-run observatory rollups —
 ``registry`` (the engine's run-finish stamp that joins the event log to
 ``runs/index.jsonl``, utils/registry.py) and ``gate`` (one behavioral-
-drift verdict per pinned cell, tools/science_gate.py).  Readers accept
-every version; older logs simply never carry the newer kinds, and a
-newer-only kind stamped with an older version is an emitter bug,
-rejected (``KIND_MIN_VERSION``).
+drift verdict per pinned cell, tools/science_gate.py); v5 adds
+``secagg`` — one secure-aggregation protocol record per round
+(protocols/secagg.py: masks reconstructed, dropout-recovery flag,
+bitwise sum-check verdict, per-group sum norms under groupwise).
+Readers accept every version; older logs simply never carry the newer
+kinds, and a newer-only kind stamped with an older version is an
+emitter bug, rejected (``KIND_MIN_VERSION``).
 """
 
 from __future__ import annotations
@@ -47,8 +50,8 @@ from typing import Optional
 import numpy as np
 
 
-SCHEMA_VERSION = 4
-SUPPORTED_VERSIONS = (1, 2, 3, 4)
+SCHEMA_VERSION = 5
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5)
 
 # kind -> required fields.  Producers: core/engine.py (round, eval, asr,
 # profile, stream, defense, attack, selection_hist via RunLogger).
@@ -104,13 +107,21 @@ EVENT_KINDS = {
     # pinned cell's name and its pass/fail/skip status, with the
     # compared metrics as extra fields
     "gate": {"cell", "status"},
+    # --- v5: the secure-aggregation protocol layer (protocols/secagg.py)
+    # one protocol record per round (emitted with or without
+    # --telemetry, like 'fault'): bitwise sum-check verdict
+    # (sum_check_ok), dropped-client count, masks reconstructed in the
+    # simulated seed-reveal (recovery), and under groupwise the
+    # per-group sum norms — the server-visible quantities
+    "secagg": {"round"},
 }
 
 # Minimum schema version per kind introduced after v1; an event carrying
 # one of these but stamped with an older version is an emitter bug (an
 # older writer cannot know these kinds).
 KIND_MIN_VERSION = {"compile": 2, "cost": 2, "heartbeat": 2,
-                    "lifecycle": 3, "registry": 4, "gate": 4}
+                    "lifecycle": 3, "registry": 4, "gate": 4,
+                    "secagg": 5}
 
 # Back-compat alias (pre-v3 spelling used by external readers).
 V2_KINDS = {k for k, v in KIND_MIN_VERSION.items() if v == 2}

@@ -11,7 +11,9 @@ Speaks every supported schema version (v1, plus v2's compile/cost/
 heartbeat kinds, plus v3's lifecycle kind — the preempt/resume/retry/
 degrade transitions of utils/lifecycle.py — plus v4's cross-run
 observatory kinds: 'registry' run-finish stamps, utils/registry.py,
-and 'gate' behavioral-drift verdicts, tools/science_gate.py).  An
+and 'gate' behavioral-drift verdicts, tools/science_gate.py — plus
+v5's 'secagg' kind: one secure-aggregation protocol record per round,
+protocols/secagg.py).  An
 event stamped with a
 version this reader does not know is reported as "produced by a newer
 writer" — a clear per-line error, never a KeyError — and a newer-only

@@ -180,6 +180,70 @@ def memproof() -> int:
           f"{bound / 1e6:.0f} MB (vs (n,d)={n * d * 4 / 1e6:.0f} MB); "
           f"no (n,d)/(n,n) tensor in the HLO; "
           f"flops={facts['flops']:.3e}")
+    return wireproof()
+
+
+# --- secagg structural proof (ISSUE 7 acceptance) ----------------------
+# Baseline-free like the memproof: compile one --secagg vanilla round
+# and gate its structural HLO facts (protocols/secagg.py
+# wire_hlo_facts) — the masked u32 wire must exist (the optimization
+# barrier kept the compiler from cancelling the protocol away), the
+# server-side reconstruction of the per-client matrix may feed ONLY
+# the cohort-sum reduce (no defense/sort/diagnostic reads per-client
+# rows post-masking), and no (n, n) distance matrix may exist.
+
+WIREPROOF = dict(n=19, batch=16)
+
+
+def wireproof() -> int:
+    import jax.numpy as jnp
+
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.attacks import DriftAttack
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+    from attacking_federate_learning_tpu.protocols.secagg import (
+        wire_hlo_facts
+    )
+
+    n = WIREPROOF["n"]
+    cfg = ExperimentConfig(
+        dataset=C.SYNTH_MNIST, users_count=n, mal_prop=0.21,
+        batch_size=WIREPROOF["batch"], epochs=5, test_step=5, seed=0,
+        synth_train=256, synth_test=64, defense="NoDefense",
+        secagg="vanilla")
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=256, synth_test=64)
+    exp = FederatedExperiment(cfg, attacker=DriftAttack(1.5), dataset=ds)
+    text = exp._fused_round.lower(exp.state, jnp.asarray(0, jnp.int32),
+                                  None).compile().as_text()
+    facts = wire_hlo_facts(text, n, exp.flat.dim)
+    problems = []
+    if not facts["wire_present"]:
+        problems.append("wireproof: no u32 (n, d) wire tensor in the "
+                        "vanilla-secagg round HLO — the masking was "
+                        "compiled away")
+    if not facts["unmask_reduce_only"]:
+        problems.append(
+            f"wireproof: the reconstructed per-client matrix has "
+            f"non-reduce consumers "
+            f"({facts['unmask_instructions']} unmask instruction(s)) — "
+            f"a server-side op reads per-client rows post-masking")
+    if facts["distance_matrix"]:
+        problems.append("wireproof: an (n, n) distance matrix exists "
+                        "under secagg — a pairwise defense ran over "
+                        "per-client rows")
+    if problems:
+        print(f"FAIL perf_gate --memproof (secagg wireproof): "
+              f"{len(problems)} violation(s)")
+        for prob in problems:
+            print(f"  {prob}")
+        return 1
+    print(f"ok   perf_gate wireproof: secagg-vanilla round @ n={n}: "
+          f"u32 wire present, unmask feeds only the cohort-sum "
+          f"reduce, no (n, n) distance matrix")
     return 0
 
 
@@ -253,9 +317,10 @@ def main(argv=None) -> int:
                         "failure instead of a skip")
     p.add_argument("--memproof", action="store_true",
                    help="additionally run the hierarchical O(m*d) "
-                        "memory proof at the 10k north star (absolute "
-                        "bound, no baseline; ~15 s — tools/smoke.sh "
-                        "leg 7 runs it)")
+                        "memory proof at the 10k north star and the "
+                        "secagg-vanilla wire proof (absolute "
+                        "structural facts, no baseline; ~20 s — "
+                        "tools/smoke.sh leg 4 runs both)")
     args = p.parse_args(argv)
 
     cells = [c.strip() for c in args.cells.split(",") if c.strip()]

@@ -78,6 +78,19 @@ CELLS = {
     "trimmedmean_alie15": dict(defense="TrimmedMean", z=1.5),
     "bulyan_alie15": dict(defense="Bulyan", z=1.5),
     "backdoor_trimmedmean": dict(defense="TrimmedMean", backdoor=True),
+    # --- PR 7: the secure-aggregation scenario (protocols/secagg.py).
+    # vanilla must replay the clear NoDefense cell bit-for-bit (the
+    # protocol is behaviorally invisible — masking cancels exactly),
+    # so its values double as a cross-cell invariant with
+    # nodefense_alie05.  groupwise composes with the two-tier tree:
+    # n=20/m=5 so the megabatch divides, tier-2 Krum over group sums
+    # (selection-mediated -> banded like the krum cells).
+    "secagg_vanilla_alie05": dict(defense="NoDefense", z=0.5,
+                                  secagg="vanilla"),
+    "secagg_groupwise_alie15": dict(defense="NoDefense", z=1.5, n=20,
+                                    mal_prop=0.2, secagg="groupwise",
+                                    aggregation="hierarchical",
+                                    megabatch=5, tier2_defense="Krum"),
 }
 
 # Per-metric tolerance bands (absolute; 0 = exact).  Authored here,
@@ -105,6 +118,12 @@ CELL_BANDS = {
     "trimmedmean_alie15": {"final_accuracy": 2.0, "max_accuracy": 2.0},
     "backdoor_trimmedmean": {"final_accuracy": 2.0, "max_accuracy": 2.0,
                              "final_asr": 5.0},
+    # vanilla secagg is the NoDefense mean over a bit-identically
+    # recovered matrix: no selection anywhere, so exact (band 0 via
+    # DEFAULT_BANDS).  groupwise runs tier-2 Krum over group sums:
+    # selection-mediated, same band family as the krum cells.
+    "secagg_groupwise_alie15": {"final_accuracy": 2.0,
+                                "max_accuracy": 2.0},
 }
 
 
@@ -142,14 +161,19 @@ def measure_cell(name: str, spec: dict, rounds: int = ROUNDS) -> dict:
     backdoor = spec.get("backdoor", False)
     attacked = spec.get("attack", "alie") is not None or backdoor
     cfg = ExperimentConfig(
-        dataset=C.SYNTH_MNIST_HARD, users_count=19,
-        mal_prop=0.21 if attacked else 0.0, batch_size=64,
+        dataset=C.SYNTH_MNIST_HARD, users_count=spec.get("n", 19),
+        mal_prop=spec.get("mal_prop", 0.21 if attacked else 0.0),
+        batch_size=64,
         epochs=rounds, test_step=max(1, rounds // 2), seed=0,
         synth_train=4000, synth_test=1000,
         defense=spec["defense"],
         num_std=spec.get("z", 1.5),
         backdoor="pattern" if backdoor else False,
-        telemetry=bool(spec.get("telemetry")))
+        telemetry=bool(spec.get("telemetry")),
+        secagg=spec.get("secagg", "off"),
+        aggregation=spec.get("aggregation", "flat"),
+        megabatch=spec.get("megabatch", 0),
+        tier2_defense=spec.get("tier2_defense"))
     ds = load_dataset(cfg.dataset, seed=0, synth_train=cfg.synth_train,
                       synth_test=cfg.synth_test)
     if backdoor:

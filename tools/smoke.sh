@@ -1,9 +1,10 @@
 #!/usr/bin/env bash
 # One-liner CI smoke: event-schema validation + fault matrix + crash
-# matrix + perf gate (incl. hierarchical memproof) + science gate +
-# registry selfcheck + hierarchical-aggregation smoke.
+# matrix + perf gate (incl. hierarchical memproof + secagg wireproof) +
+# science gate + registry selfcheck + hierarchical-aggregation smoke +
+# secure-aggregation smoke.
 #
-#   bash tools/smoke.sh            # all seven, CPU-pinned
+#   bash tools/smoke.sh            # all eight, CPU-pinned
 #   bash tools/smoke.sh --fast     # skip the fault + crash matrices
 #                                  # (the two slowest legs)
 #
@@ -28,7 +29,13 @@
 #   7. hierarchical-aggregation smoke — a 5-round journaled
 #      hierarchical x {Krum, TrimmedMean} run each (two-tier streaming
 #      engine, ops/federated.py), then a journal audit: every round and
-#      eval committed exactly once (utils/lifecycle.py RunJournal).
+#      eval committed exactly once (utils/lifecycle.py RunJournal);
+#   8. secure-aggregation smoke — a 5-round journaled --secagg vanilla
+#      run with injected dropout (every dropout round must complete as
+#      a mask-reconstruction round with the bitwise sum check passing)
+#      and a 5-round journaled --secagg groupwise x tier-2 Krum run
+#      (protocols/secagg.py), then the same journal audit plus a
+#      'secagg'-event audit over the private run logs.
 #
 # Exit: nonzero if any leg fails.  Always CPU (the gates' baselines are
 # CPU artifacts, and the matrices must not touch a TPU capture).
@@ -43,32 +50,32 @@ fail=0
 shopt -s nullglob
 jsonls=(logs/*.jsonl)
 if [ ${#jsonls[@]} -gt 0 ]; then
-    echo "== smoke 1/7: check_events (${#jsonls[@]} logs) =="
+    echo "== smoke 1/8: check_events (${#jsonls[@]} logs) =="
     python tools/check_events.py "${jsonls[@]}" || fail=1
 else
-    echo "== smoke 1/7: check_events — no logs/*.jsonl yet, skipped =="
+    echo "== smoke 1/8: check_events — no logs/*.jsonl yet, skipped =="
 fi
 
 crash_work=""
 if [ "${1:-}" != "--fast" ]; then
-    echo "== smoke 2/7: fault_matrix =="
+    echo "== smoke 2/8: fault_matrix =="
     python tools/fault_matrix.py || fail=1
-    echo "== smoke 3/7: crash_matrix (supervised preempt/resume) =="
+    echo "== smoke 3/8: crash_matrix (supervised preempt/resume) =="
     # Keep the matrix's run stores: leg 6 registry-checks them.
     crash_work="$(mktemp -d -t crash_matrix_XXXXXX)"
     python tools/crash_matrix.py --workdir "$crash_work" || fail=1
 else
-    echo "== smoke 2/7: fault_matrix — skipped (--fast) =="
-    echo "== smoke 3/7: crash_matrix — skipped (--fast) =="
+    echo "== smoke 2/8: fault_matrix — skipped (--fast) =="
+    echo "== smoke 3/8: crash_matrix — skipped (--fast) =="
 fi
 
-echo "== smoke 4/7: perf_gate (+ hierarchical memproof) =="
+echo "== smoke 4/8: perf_gate (+ hierarchical memproof) =="
 python tools/perf_gate.py --memproof || fail=1
 
-echo "== smoke 5/7: science_gate (behavioral drift) =="
+echo "== smoke 5/8: science_gate (behavioral drift) =="
 python tools/science_gate.py || fail=1
 
-echo "== smoke 6/7: runs selfcheck (registry) =="
+echo "== smoke 6/8: runs selfcheck (registry) =="
 python -m attacking_federate_learning_tpu.cli runs selfcheck || fail=1
 if [ -n "$crash_work" ]; then
     # The registry over the crash matrix's preempt/resume artifacts:
@@ -85,7 +92,7 @@ if [ -n "$crash_work" ]; then
     rm -rf "$crash_work"
 fi
 
-echo "== smoke 7/7: hierarchical aggregation (journaled, audited) =="
+echo "== smoke 7/8: hierarchical aggregation (journaled, audited) =="
 hier_work="$(mktemp -d -t hier_smoke_XXXXXX)"
 for def in Krum TrimmedMean; do
     python -m attacking_federate_learning_tpu.cli \
@@ -110,6 +117,55 @@ for rid in ("hier_Krum_smoke", "hier_TrimmedMean_smoke"):
 sys.exit(bad)
 PY
 rm -rf "$hier_work"
+
+echo "== smoke 8/8: secure aggregation (journaled, audited) =="
+sa_work="$(mktemp -d -t secagg_smoke_XXXXXX)"
+# vanilla: one dropout-rate high enough that the 5-round seeded run is
+# guaranteed (and pinned by the audit below) to include at least one
+# mask-reconstruction round.
+python -m attacking_federate_learning_tpu.cli \
+    -d NoDefense -s SYNTH_MNIST -n 12 -m 0.25 -c 16 -e 5 \
+    --synth-train 256 --synth-test 64 \
+    --secagg vanilla --fault-dropout 0.25 \
+    --journal --run-id secagg_vanilla_smoke --no-checkpoint \
+    --log-dir "$sa_work/logs" --run-dir "$sa_work/runs" \
+    > /dev/null || fail=1
+# groupwise x tier-2 Krum over per-group sums (the NET-SA composition
+# with the two-tier tree).
+python -m attacking_federate_learning_tpu.cli \
+    -d NoDefense --tier2-defense Krum -s SYNTH_MNIST -n 12 -m 0.25 \
+    -c 16 -e 5 --synth-train 256 --synth-test 64 \
+    --secagg groupwise --aggregation hierarchical --megabatch 4 \
+    --journal --run-id secagg_groupwise_smoke --no-checkpoint \
+    --log-dir "$sa_work/logs" --run-dir "$sa_work/runs" \
+    > /dev/null || fail=1
+python - "$sa_work" <<'PY' || fail=1
+import json, os, sys
+from attacking_federate_learning_tpu.utils.lifecycle import RunJournal
+work = sys.argv[1]
+bad = 0
+for rid in ("secagg_vanilla_smoke", "secagg_groupwise_smoke"):
+    problems = RunJournal(os.path.join(work, "runs"), rid).verify(
+        epochs=5, test_step=5)
+    events = [json.loads(line) for line in
+              open(os.path.join(work, "logs", rid + ".jsonl"))]
+    sec = [e for e in events if e.get("kind") == "secagg"]
+    if len(sec) != 5:
+        problems.append(f"{len(sec)} secagg events, want one per round")
+    if any(not e.get("sum_check_ok") for e in sec):
+        problems.append("bitwise sum check failed")
+    if rid == "secagg_vanilla_smoke":
+        rec = sum(e.get("recovery", 0) for e in sec)
+        masks = sum(e.get("masks_reconstructed", 0) for e in sec)
+        if rec < 1 or masks < 1:
+            problems.append(f"no dropout-recovery round fired "
+                            f"(recovery={rec}, masks={masks})")
+    status = "ok" if not problems else f"FAIL {problems}"
+    print(f"  secagg {rid}: {status}")
+    bad |= bool(problems)
+sys.exit(bad)
+PY
+rm -rf "$sa_work"
 
 if [ $fail -ne 0 ]; then
     echo "SMOKE FAILED"
