@@ -323,7 +323,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "norms — device-side aux outputs of the jitted "
                         "round, written as 'defense'/'attack'/"
                         "'selection_hist' events (read with the 'report' "
-                        "subcommand)")
+                        "subcommand).  Under --aggregation hierarchical "
+                        "(and --secagg groupwise) the same flag emits "
+                        "per-shard tier-1 + tier-2 'shard_selection' "
+                        "events — read with 'report forensics'")
     p.add_argument("--trace-dir", type=str, default=None,
                    help="capture a jax.profiler XLA trace into this dir")
     p.add_argument("--cost-report", action="store_true",
@@ -460,7 +463,7 @@ def main(argv=None):
         return report_main(argv[1:])
     if argv and argv[0] == "runs":
         # Cross-run registry subcommand (runs_cli.py): list/show/diff/
-        # compare/tag/trace/selfcheck over runs/index.jsonl
+        # compare/tag/trace/forensics/selfcheck over runs/index.jsonl
         # (utils/registry.py).  Pure log/JSON reading, no jax; same
         # pre-argparse dispatch as 'report'.
         from attacking_federate_learning_tpu.runs_cli import (

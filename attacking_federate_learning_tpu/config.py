@@ -393,7 +393,13 @@ class ExperimentConfig:
     # carried as auxiliary outputs of the jitted round, stacked across
     # rounds and fetched once per eval interval (NO host callbacks
     # inside the jit), then written as 'defense'/'attack'/
-    # 'selection_hist' events.  Off by default: the compiled round
+    # 'selection_hist' events.  Under aggregation='hierarchical' the
+    # same flag threads the stacked per-shard tier-1 diagnostics and
+    # the tier-2 shard-selection record out of the scanned round as
+    # 'shard_selection' events (schema v6; read with 'report
+    # forensics'); under --secagg groupwise only the tier-2
+    # (group-sum-level) view appears — per-client rows are not
+    # server-visible there.  Off by default: the compiled round
     # program is bit-identical to the pre-telemetry one.
     telemetry: bool = False
 
@@ -536,16 +542,20 @@ class ExperimentConfig:
                 raise ValueError(
                     "--secagg groupwise exposes per-megabatch sums and "
                     "requires --aggregation hierarchical (+ --megabatch)")
-            if self.telemetry:
+            if self.telemetry and self.secagg == "vanilla":
                 raise ValueError(
-                    "--telemetry is server-side per-client forensics "
-                    "(selection masks, per-row norms); under --secagg "
-                    "the server sees no per-client rows")
-            if self.log_round_stats:
+                    "--telemetry is server-side forensics; under "
+                    "--secagg vanilla the server sees only one masked "
+                    "cohort sum — there is nothing per-client OR "
+                    "per-group to observe (groupwise supports "
+                    "--telemetry: tier-2 selection over group sums is "
+                    "server-visible)")
+            if self.log_round_stats and self.secagg == "vanilla":
                 raise ValueError(
                     "--round-stats reads per-client gradient norms "
-                    "server-side; under --secagg the server sees no "
-                    "per-client rows")
+                    "server-side; under --secagg vanilla the server "
+                    "sees no per-client rows (groupwise supports "
+                    "--round-stats over the per-group sums)")
             if self.backdoor and not self.backdoor_fused:
                 raise ValueError(
                     "--backdoor-staged crafts on the host between "

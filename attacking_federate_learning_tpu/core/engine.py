@@ -65,11 +65,14 @@ from attacking_federate_learning_tpu.utils.metrics import RunLogger
 
 def _jsonable(v):
     """Host telemetry leaf -> JSON value: 0-d arrays to float, vectors
-    to lists (the event schema stores fixed-shape vectors inline)."""
+    to lists, matrices — the hierarchical (S, m) per-shard stacks — to
+    nested lists (the event schema stores fixed-shape arrays inline)."""
     a = np.asarray(v)
     if a.ndim == 0:
         return float(a)
-    return [float(x) for x in a]
+    if a.ndim == 1:
+        return [float(x) for x in a]
+    return a.astype(float).tolist()
 
 
 class FederatedExperiment:
@@ -284,13 +287,18 @@ class FederatedExperiment:
         The client axis lives inside a scanned device program, so every
         feature that needs the materialized (n, d) matrix — or a host
         hop per round — is rejected here rather than failing deep in a
-        trace: per-round telemetry/round-stats (they thread (n,)-shaped
-        diagnostics out of the defense call), fault injection (the
+        trace: fault injection (the
         quarantine mask is an (n,) row mask over the full matrix),
         partial participation (cohort sampling composes with placement
         in a follow-up), host streaming (one round per program by
         design), and the opt-in host kernels (a pure_callback per
-        megabatch per scan step would marshal more than it saves)."""
+        megabatch per scan step would marshal more than it saves).
+        Telemetry and round-stats are SUPPORTED (ISSUE 8): per-shard
+        tier-1 diagnostics ride the scan as stacked fixed-shape
+        pytrees — (S, m)-shaped, never (n,)-shaped, so the O(m·d)
+        memory contract survives — and the tier-2 kernels emit their
+        (S,)-shaped shard-selection record ('shard_selection' events,
+        schema v6)."""
         cfg = self.cfg
         from attacking_federate_learning_tpu.defenses.kernels import (
             TIER2_DEFENSES, check_tier2_args
@@ -308,11 +316,6 @@ class FederatedExperiment:
                 "hierarchical aggregation requires "
                 "data_placement='device' (the scanned round gathers "
                 "each megabatch's batch on device)")
-        if cfg.telemetry or cfg.log_round_stats:
-            raise ValueError(
-                "hierarchical aggregation does not support "
-                "telemetry/log_round_stats yet (per-round diagnostics "
-                "are shaped by the full cohort)")
         if cfg.faults is not None and cfg.faults.enabled:
             raise ValueError(
                 "hierarchical aggregation does not support fault "
@@ -978,13 +981,34 @@ class FederatedExperiment:
             from attacking_federate_learning_tpu.protocols.secagg import (
                 secagg_group
             )
+        if groupwise and cfg.telemetry:
+            from attacking_federate_learning_tpu.protocols.secagg import (
+                group_envelope_stats
+            )
+        tele_on = cfg.telemetry
+        # Per-client gradient norms are observable only in the CLEAR
+        # hierarchical modes: under groupwise secagg the server sees
+        # group sums, not rows, so the shard norm stack (and the
+        # round-stats gradient-norm triple) would read a tensor the
+        # threat model says the server never holds.
+        want_norms = ((tele_on or cfg.log_round_stats)
+                      and not groupwise)
+        # Any extra per-shard output switches shard_fn to the dict
+        # pytree; with everything off the return structure (and the
+        # traced program) is byte-for-byte the pre-telemetry tuple.
+        extras = tele_on or cfg.log_round_stats
 
         def shard_fn(ids, c_mal, state, t):
             """One megabatch: ids (m,) client ids (malicious first —
             the per-megabatch mirror of the rows-[0, f) invariant),
             c_mal its STATIC malicious count.  Returns the (d,) f32
             tier-1 estimate and the megabatch's nan flag (plus, under
-            groupwise secagg, the group's bitwise sum-check verdict)."""
+            groupwise secagg, the group's bitwise sum-check verdict).
+            With telemetry/round-stats on it returns a dict pytree
+            carrying the tier-1 diagnostics (``diag`` — the flat
+            kernel's telemetry on THIS shard's sub-matrix, stacked by
+            client_map into the (S, ...) shard_selection record) and,
+            in the clear modes, the per-row gradient norms."""
             shard_rows = self.shards[ids]
             idx = round_batch_indices(
                 shard_rows, t, cfg.batch_size * cfg.local_steps)
@@ -1019,16 +1043,51 @@ class FederatedExperiment:
                 # the plain hierarchical NoDefense tier's.
                 grads, sum_ok = secagg_group(grads, self._secagg_key,
                                              t, ids)
+                if not extras:
+                    est = self.defense_fn(grads, m, f1)
+                    return est.astype(jnp.float32), bad, sum_ok
+                out = {"bad": bad, "sum_ok": sum_ok}
+                if tele_on:
+                    # NoDefense tier-1 (config-enforced under secagg)
+                    # has an empty diagnostics pytree — nothing
+                    # per-client ever leaves the group.
+                    est, diag = self.defense_fn(grads, m, f1,
+                                                telemetry=True)
+                    out["diag"] = diag
+                else:
+                    est = self.defense_fn(grads, m, f1)
+                out["est"] = est.astype(jnp.float32)
+                return out
+            if not extras:
                 est = self.defense_fn(grads, m, f1)
-                return est.astype(jnp.float32), bad, sum_ok
-            est = self.defense_fn(grads, m, f1)
-            return est.astype(jnp.float32), bad
+                return est.astype(jnp.float32), bad
+            out = {"bad": bad}
+            if tele_on:
+                est, diag = self.defense_fn(grads, m, f1,
+                                            telemetry=True)
+                out["diag"] = diag
+            else:
+                est = self.defense_fn(grads, m, f1)
+            out["est"] = est.astype(jnp.float32)
+            if want_norms:
+                out["norms"] = jnp.linalg.norm(
+                    grads.astype(jnp.float32), axis=1)
+            return out
 
         def hier_core(state, t):
             tele = {}
+            out = client_map(shard_fn, place, state, t)
+            norms = diag1 = sum_oks = None
+            if extras:
+                ests, bads = out["est"], out["bad"]
+                sum_oks = out.get("sum_ok")
+                norms = out.get("norms")        # (S, m) clear modes
+                diag1 = out.get("diag")         # stacked tier-1 pytree
+            elif groupwise:
+                ests, bads, sum_oks = out
+            else:
+                ests, bads = out
             if groupwise:
-                ests, bads, sum_oks = client_map(shard_fn, place,
-                                                 state, t)
                 # Per-group sum norms are server-visible under
                 # group-wise secagg (each estimate is sum/m): the v5
                 # 'secagg' event's observable quantity.
@@ -1043,28 +1102,70 @@ class FederatedExperiment:
                     "secagg_group_sum_norms":
                         jnp.linalg.norm(ests, axis=1) * m,
                 }
+                if tele_on:
+                    # Group-sum envelope (protocols/secagg.py): the
+                    # population view the server can still compute
+                    # when groups, not clients, are the visible unit.
+                    env = group_envelope_stats(ests, m)
+                    tele["secagg_group_cos_to_mean"] = (
+                        env["group_cos_to_mean"])
+            if tele_on:
+                if diag1:
+                    for dk, dv in diag1.items():
+                        tele["shard_" + dk] = dv
+                if norms is not None:
+                    tele["shard_grad_norms"] = norms
+                agg, diag2 = shard_reduce(tier2_fn, ests, S, f2,
+                                          plan=self.shardings,
+                                          telemetry=True)
+                for dk, dv in diag2.items():
+                    tele["tier2_" + dk] = dv
+                tele["tier2_est_norms"] = jnp.linalg.norm(
+                    ests.astype(jnp.float32), axis=1)
             else:
-                ests, bads = client_map(shard_fn, place, state, t)
-            agg = shard_reduce(tier2_fn, ests, S, f2,
-                               plan=self.shardings)
+                agg = shard_reduce(tier2_fn, ests, S, f2,
+                                   plan=self.shardings)
             new_state = self._aggregate_impl(state, None, t, agg=agg)
             bad = (bads.any() if self._check_attack_nan
                    else jnp.asarray(False))
-            return new_state, bad, tele
+            diag = {}
+            if cfg.log_round_stats:
+                # The flat round_diagnostics re-read over what this
+                # mode can observe: exact per-client norm stats in the
+                # clear modes (the (S, m) stack holds the same n
+                # values), group-sum norm stats under groupwise.
+                diag = {
+                    "update_norm": jnp.linalg.norm(new_state.velocity),
+                    "faded_lr": faded_learning_rate(
+                        cfg.learning_rate, cfg.fading_rate, t),
+                }
+                if norms is not None:
+                    diag.update(
+                        grad_norm_mean=jnp.mean(norms),
+                        grad_norm_max=jnp.max(norms),
+                        grad_norm_min=jnp.min(norms))
+                else:
+                    gs = jnp.linalg.norm(
+                        ests.astype(jnp.float32), axis=1) * m
+                    diag.update(
+                        group_sum_norm_mean=jnp.mean(gs),
+                        group_sum_norm_max=jnp.max(gs),
+                        group_sum_norm_min=jnp.min(gs))
+            return new_state, diag, bad, tele
 
         def fused(state, t, batches=None):
             # `batches` mirrors the flat signature (run_round always
             # passes it); hierarchical is device-resident-only, so it
             # is always None (validated at init).
-            new_state, bad, tele = hier_core(state, t)
-            return new_state, {}, bad, tele
+            new_state, diag, bad, tele = hier_core(state, t)
+            return new_state, diag, bad, tele
 
         def fused_span(state, t0, count):
             # Same traced-count fori_loop as the flat span: one
             # compilation covers every span length.
             def body(i, carry):
                 s, bad = carry
-                s2, b, _ = hier_core(s, t0 + i)
+                s2, _, b, _ = hier_core(s, t0 + i)
                 if self._check_attack_nan:
                     bad = bad | b
                 return s2, bad
@@ -1073,12 +1174,13 @@ class FederatedExperiment:
                                      (state, jnp.asarray(False)))
 
         def tele_span(state, t0, count):
-            # Groupwise secagg's per-round protocol stats come back
-            # stacked, exactly like the flat engine's telemetry span
-            # (static count: one compilation per distinct span length).
+            # Per-round telemetry pytrees (and groupwise secagg's
+            # protocol stats) come back stacked, exactly like the flat
+            # engine's telemetry span (static count: one compilation
+            # per distinct span length).
             def body(carry, i):
                 s, bad = carry
-                s2, b, tele = hier_core(s, t0 + i)
+                s2, _, b, tele = hier_core(s, t0 + i)
                 if self._check_attack_nan:
                     bad = bad | b
                 return (s2, bad), tele
@@ -1090,7 +1192,7 @@ class FederatedExperiment:
         donate = self._donate_kw()
         self._fused_round = jax.jit(fused, **donate)
         self._fused_span = jax.jit(fused_span, **donate)
-        if groupwise:
+        if groupwise or cfg.telemetry:
             self._tele_span = jax.jit(tele_span, static_argnums=2,
                                       **donate)
         self._staged = False
@@ -1157,9 +1259,13 @@ class FederatedExperiment:
                             self.state, t0,
                             jnp.asarray(span_len, jnp.int32))))
                     if cfg.telemetry:
+                        # Hierarchical engines ledger their telemetry
+                        # span under their own name so the perf gate
+                        # can pin the hier-tele cost cells separately.
                         entries.append(
-                            ("tele_span", lambda: self._tele_span.lower(
-                                self.state, t0, span_len)))
+                            ("hier_tele_span" if hier else "tele_span",
+                             lambda: self._tele_span.lower(
+                                 self.state, t0, span_len)))
             else:
                 entries.append(("fused_round", lambda: self._fused_round
                                 .lower(self.state, t0, self._fault_state,
@@ -1445,14 +1551,32 @@ class FederatedExperiment:
                     grads, self.state, t, aux)
         return self.state
 
+    def _shard_static_fields(self):
+        """The placement ground truth every 'shard_selection' event
+        carries (host-side statics): which defenses ran per tier, the
+        megabatch size, and each shard's malicious-row count — what
+        the forensics layer (report.py) attributes tier-2 rejections
+        against.  Shared with tools/science_gate.py so the gate's
+        replayed cells see exactly what a logged run records."""
+        pl = self._placement
+        return {"defense": self.cfg.defense,
+                "tier2_defense": self._tier2_name,
+                "megabatch": pl.megabatch,
+                "mal_counts": list(pl.mal_counts),
+                "mal_placement": self.cfg.mal_placement,
+                "tier1_corrupted": self._tier1_f,
+                "tier2_corrupted": self._tier2_f}
+
     def _emit_round_telemetry(self, logger, t, tele):
         """Write one round's telemetry (host values) as 'defense' and
         'attack' events (cfg.telemetry), its 'fault_*' counts as a
-        'fault' event and its 'secagg_*' protocol stats as a 'secagg'
-        event (both emitted with or without telemetry); track Krum
-        winners for the end-of-run selection histogram."""
+        'fault' event, its 'secagg_*' protocol stats as a 'secagg'
+        event (both emitted with or without telemetry), and — for
+        hierarchical rounds — its 'shard_*'/'tier2_*' stacks as one
+        schema-v6 'shard_selection' event; track Krum winners for the
+        end-of-run selection histogram."""
         defense_fields, attack_fields = {}, {}
-        fault_fields, secagg_fields = {}, {}
+        fault_fields, secagg_fields, shard_fields = {}, {}, {}
         for k, v in tele.items():
             val = _jsonable(v)
             if k.startswith("attack_"):
@@ -1465,6 +1589,12 @@ class FederatedExperiment:
                 secagg_fields[k[len("secagg_"):]] = (
                     int(val) if isinstance(val, float)
                     and float(val).is_integer() else val)
+            elif k.startswith(("shard_", "tier2_")):
+                # Hierarchical forensics stacks keep their tier prefix
+                # — 'shard_selection_mask' (S, m) and
+                # 'tier2_selection_mask' (S,) are different axes of
+                # the same round and land in one event.
+                shard_fields[k] = val
             elif k.startswith("defense_"):
                 defense_fields[k[len("defense_"):]] = val
             else:
@@ -1475,9 +1605,13 @@ class FederatedExperiment:
             logger.record(kind="secagg", round=int(t), **secagg_fields)
         if not self.cfg.telemetry:
             return
-        logger.record(kind="defense", round=int(t),
-                      defense=self.cfg.defense,
-                      malicious_count=self.m_mal, **defense_fields)
+        if shard_fields:
+            logger.record(kind="shard_selection", round=int(t),
+                          **self._shard_static_fields(), **shard_fields)
+        if defense_fields:
+            logger.record(kind="defense", round=int(t),
+                          defense=self.cfg.defense,
+                          malicious_count=self.m_mal, **defense_fields)
         if attack_fields:
             logger.record(kind="attack", round=int(t),
                           attack=self.attacker.name, **attack_fields)

@@ -802,23 +802,37 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
 # ``mask=`` seam (a fully-dead shard's estimate can never win selection
 # or touch a trim).  No new estimator math: the mask-aware paths are
 # reused unchanged, which is what keeps tier-2 oracle-verified for free.
+#
+# Telemetry seam (ISSUE 8): every shard_* entry accepts the same
+# trace-time ``telemetry=`` flag as the flat kernels and forwards it —
+# the returned diagnostics pytree is the flat kernel's, re-read over
+# the SHARD axis: a (S,) ``selection_mask`` says which shards'
+# estimates the tier-2 reduction selected/kept/rejected, which is the
+# raw material of the colluder-localization forensics (report.py).
+# With it off (the default) the call is byte-for-byte the
+# pre-telemetry path, same as the flat kernels' contract.
 
 def _alive_to_mask(alive_counts):
     return None if alive_counts is None else alive_counts > 0
 
 
 def shard_mean(shard_estimates, shard_count, corrupted_shards,
-               alive_counts=None):
+               alive_counts=None, telemetry=False):
     """Tier-2 NoDefense: alive-count-weighted mean of the shard
     estimates — with equal megabatches and no faults this is exactly
     the flat FedAvg mean (each estimate already averages m clients);
     with faults the weights restore the flat masked mean's
-    per-client weighting."""
+    per-client weighting.  ``telemetry=True`` returns ``(agg, {})`` —
+    a mean rejects nothing, so there is nothing to attribute."""
     del corrupted_shards
     if alive_counts is None:
-        return jnp.mean(shard_estimates, axis=0)
-    w = alive_counts.astype(jnp.float32)
-    return (w @ shard_estimates) / jnp.maximum(jnp.sum(w), 1.0)
+        agg = jnp.mean(shard_estimates, axis=0)
+    else:
+        w = alive_counts.astype(jnp.float32)
+        agg = (w @ shard_estimates) / jnp.maximum(jnp.sum(w), 1.0)
+    if not telemetry:
+        return agg
+    return agg, {}
 
 
 def shard_krum(shard_estimates, shard_count, corrupted_shards,

@@ -170,7 +170,11 @@ def shard_reduce(tier2_fn, estimates, num_shards: int,
     the fault masks — a fully-dead shard's estimate is excluded.
     Under a MeshPlan the estimate matrix is constrained to the
     clients-axis layout first so the reduction's collectives are
-    explicit."""
+    explicit.  ``telemetry=True`` (forwarded through ``**kw`` to the
+    shard_* entry) additionally returns the tier-2 diagnostics pytree
+    — (S,)-shaped selection masks/scores over the SHARD axis, the
+    which-estimates-were-rejected record the forensics layer
+    attributes colluder placement from (report.py)."""
     estimates = estimates.astype(jnp.float32)
     if plan is not None:
         estimates = plan.constrain_estimates(estimates)
@@ -180,7 +184,8 @@ def shard_reduce(tier2_fn, estimates, num_shards: int,
 
 def two_tier_aggregate(users_grads, placement: Placement, tier1_fn,
                        tier2_fn, tier1_corrupted: int,
-                       tier2_corrupted: int, mask=None, plan=None):
+                       tier2_corrupted: int, mask=None, plan=None,
+                       telemetry=False):
     """Reference two-tier aggregation over a MATERIALIZED (n, d) matrix.
 
     The engine's hierarchical round never builds this matrix (gradients
@@ -190,24 +195,56 @@ def two_tier_aggregate(users_grads, placement: Placement, tier1_fn,
     aggregation-only benchmarks.  ``mask`` (n,) is the quarantine seam:
     each megabatch's tier-1 runs mask-aware over its rows and tier-2
     receives the per-shard alive counts.
+
+    ``telemetry=True`` (trace-time, like the kernels' flag) returns
+    ``(agg, tier1_diag, tier2_diag)``: ``tier1_diag`` is the flat
+    kernel's diagnostics pytree stacked along a leading shard axis —
+    each row is BY CONSTRUCTION the flat kernel's telemetry on that
+    shard's sub-matrix, the bit-match contract the engine's
+    shard_selection events inherit — and ``tier2_diag`` is the
+    shard_* entry's (S,)-shaped selection record.
     """
     m = placement.megabatch
 
     def shard_fn(ids, _c, G, gmask):
         rows = G[ids]
         if gmask is None:
-            return tier1_fn(rows, m, tier1_corrupted).astype(jnp.float32)
+            if not telemetry:
+                return tier1_fn(rows, m,
+                                tier1_corrupted).astype(jnp.float32)
+            est, diag = tier1_fn(rows, m, tier1_corrupted,
+                                 telemetry=True)
+            return est.astype(jnp.float32), diag
         sm = gmask[ids]
-        est = tier1_fn(rows, m, tier1_corrupted, mask=sm)
-        return est.astype(jnp.float32), jnp.sum(sm).astype(jnp.int32)
+        if not telemetry:
+            est = tier1_fn(rows, m, tier1_corrupted, mask=sm)
+            return est.astype(jnp.float32), jnp.sum(sm).astype(jnp.int32)
+        est, diag = tier1_fn(rows, m, tier1_corrupted, mask=sm,
+                             telemetry=True)
+        return (est.astype(jnp.float32), jnp.sum(sm).astype(jnp.int32),
+                diag)
 
     out = client_map(shard_fn, placement, users_grads, mask)
+    t1_diag = None
     if mask is None:
-        estimates, alive = out, None
+        if telemetry:
+            estimates, t1_diag = out
+            alive = None
+        else:
+            estimates, alive = out, None
+    elif telemetry:
+        estimates, alive, t1_diag = out
     else:
         estimates, alive = out
-    return shard_reduce(tier2_fn, estimates, placement.num_shards,
-                        tier2_corrupted, alive_counts=alive, plan=plan)
+    if not telemetry:
+        return shard_reduce(tier2_fn, estimates, placement.num_shards,
+                            tier2_corrupted, alive_counts=alive,
+                            plan=plan)
+    agg, t2_diag = shard_reduce(tier2_fn, estimates,
+                                placement.num_shards, tier2_corrupted,
+                                alive_counts=alive, plan=plan,
+                                telemetry=True)
+    return agg, t1_diag, t2_diag
 
 
 # Megabatch sizing helper for callers that only know n (bench, docs):

@@ -238,6 +238,24 @@ def secagg_group(grads, key, t, ids):
     return recovered, stats["secagg_sum_check_ok"]
 
 
+def group_envelope_stats(group_means, megabatch):
+    """Envelope view of the server-visible tensor under groupwise
+    secagg: per-group sum norms and cosine-to-mean over the (S, d)
+    group-estimate matrix (``group_means`` = sums / m, the tensor the
+    tier-2 kernels consume) — the group-level mirror of
+    defenses/kernels.py:population_telemetry, observable WITHOUT
+    per-client visibility.  The norm spelling (``norm(mean) * m``)
+    matches the pre-telemetry v5 event's ``group_sum_norms`` bit for
+    bit; the cosine is scale-invariant so the mean matrix serves
+    directly.  Fixed shapes: two (S,) f32 vectors."""
+    E = group_means.astype(jnp.float32)
+    norms = jnp.linalg.norm(E, axis=1)
+    mean = jnp.mean(E, axis=0)
+    cos = (E @ mean) / (norms * jnp.linalg.norm(mean) + 1e-12)
+    return {"group_sum_norms": norms * megabatch,
+            "group_cos_to_mean": cos}
+
+
 # --- structural HLO witness (the perf_gate-memproof-style pin) ----------
 
 _NAME_RE = re.compile(r"\s*(%[\w.\-]+)\s*=\s*(\w+)\[([\d,]*)\]")

@@ -89,6 +89,174 @@ def selection_concentration(events):
     return out
 
 
+def tier2_attribution(event):
+    """Per-shard tier-2 selection mass and the rejected-shard set for
+    one 'shard_selection' event (schema v6).
+
+    Selection kernels (Krum one-hot, Bulyan multi-hot) attribute by
+    mask: a shard with zero mass was rejected outright.  The trimmed
+    mean attributes by kept fraction: a shard kept on fewer than half
+    its fair share of coordinates was substantially trimmed out.
+    Returns ``(mass, rejected)`` — a length-S float list and a set of
+    shard ids — or ``(None, None)`` when the tier-2 kernel exposes no
+    selection record (mean, median) or the mask is NaN (host engines).
+    Shared with utils/trace_export.py (the tier-2 rejection track)."""
+    mask = event.get("tier2_selection_mask")
+    if isinstance(mask, list) and all(x == x for x in mask):
+        mass = [float(x) for x in mask]
+        return mass, {i for i, x in enumerate(mass) if x <= 0.0}
+    kept = event.get("tier2_kept_fraction")
+    if isinstance(kept, list) and all(x == x for x in kept):
+        mass = [float(x) for x in kept]
+        fair = sum(mass) / max(len(mass), 1)
+        return mass, {i for i, x in enumerate(mass) if x < 0.5 * fair}
+    return None, None
+
+
+def _tier1_concentration(recs):
+    """Per-shard tier-1 selection rollup from the stacked (S, m)
+    'shard_selection_mask' fields: each shard's top-1 row share and
+    the selection mass its own malicious rows (rows [0, mal_counts[s])
+    — the placement's malicious-first invariant) captured.  Returns
+    None when no tier-1 masks were recorded (NoDefense tier-1, or
+    groupwise secagg where per-client rows are invisible)."""
+    per_shard: dict = {}
+    for e in recs:
+        masks = e.get("shard_selection_mask")
+        if not isinstance(masks, list) or not masks:
+            continue
+        if not isinstance(masks[0], list):
+            continue
+        counts = e.get("mal_counts") or [0] * len(masks)
+        for s, row in enumerate(masks):
+            if not all(x == x for x in row):
+                continue                      # NaN: not measured
+            d = per_shard.setdefault(
+                s, {"mass": [0.0] * len(row), "total": 0.0,
+                    "mal_mass": 0.0, "rounds": 0,
+                    "mal_rows": int(counts[s]) if s < len(counts)
+                    else 0})
+            d["rounds"] += 1
+            for i, x in enumerate(row):
+                if x > 0:
+                    d["mass"][i] += x
+                    d["total"] += x
+                    if i < d["mal_rows"]:
+                        d["mal_mass"] += x
+    if not per_shard:
+        return None
+    out = []
+    for s in sorted(per_shard):
+        d = per_shard[s]
+        top1 = max(d["mass"]) if d["total"] else 0.0
+        out.append({
+            "shard": s, "mal_rows": d["mal_rows"],
+            "rounds": d["rounds"],
+            "top1_share": round(top1 / d["total"], 4) if d["total"]
+            else None,
+            "top1_row": (int(d["mass"].index(top1)) if d["total"]
+                         else None),
+            "malicious_share": round(d["mal_mass"] / d["total"], 4)
+            if d["total"] else None,
+        })
+    return out
+
+
+def forensics_summary(events):
+    """The ISSUE 8 forensics layer over a hierarchical run's
+    'shard_selection' stream (schema v6):
+
+    - **tier-2 rejection attribution** — which megabatch groups' tier-1
+      estimates the cross-shard reduction rejected, round by round;
+    - **shard-level selection concentration** — each shard's tier-1
+      top-1 share and the mass its own malicious rows captured;
+    - **the colluder-localization verdict** — did tier-2 isolate the
+      malicious shards (ground truth: the placement's per-shard
+      malicious counts, carried by every event), and at what round did
+      the localization stabilize (the earliest round from which every
+      malicious shard stays rejected through the end of the run).
+
+    Returns None when the run carries no shard_selection events (flat
+    runs, telemetry off)."""
+    recs = sorted((e for e in events
+                   if e.get("kind") == "shard_selection"),
+                  key=lambda e: e.get("round", 0))
+    if not recs:
+        return None
+    last = recs[-1]
+    mal_counts = last.get("mal_counts")
+    mal_shards = ([s for s, c in enumerate(mal_counts) if c > 0]
+                  if isinstance(mal_counts, list) else None)
+    out = {
+        "rounds": len(recs),
+        "defense": last.get("defense"),
+        "tier2_defense": last.get("tier2_defense"),
+        "megabatch": last.get("megabatch"),
+        "mal_placement": last.get("mal_placement"),
+        "mal_counts": mal_counts,
+        "malicious_shards": mal_shards,
+    }
+    t1 = _tier1_concentration(recs)
+    if t1:
+        out["tier1"] = t1
+
+    per_round = []                 # (round, mass, rejected)
+    for e in recs:
+        mass, rejected = tier2_attribution(e)
+        if mass is not None:
+            per_round.append((int(e.get("round", 0)), mass, rejected))
+    if not per_round:
+        out["localization"] = {"verdict": "no_attribution"}
+        return out
+    S = len(per_round[0][1])
+    total = [0.0] * S
+    rejections = [0] * S
+    for _, mass, rejected in per_round:
+        for s in range(S):
+            total[s] += mass[s]
+        for s in rejected:
+            rejections[s] += 1
+    grand = sum(total) or 1.0
+    tier2 = {
+        "rounds": len(per_round),
+        "selection_share": [round(x / grand, 4) for x in total],
+        "rejections": {str(s): rejections[s] for s in range(S)
+                       if rejections[s]},
+    }
+    if mal_shards is not None:
+        tier2["malicious_share"] = round(
+            sum(total[s] for s in mal_shards) / grand, 4)
+        tier2["mal_rejected_rounds"] = sum(
+            1 for _, _, rej in per_round
+            if all(s in rej for s in mal_shards))
+    out["tier2"] = tier2
+
+    if mal_shards is None:
+        loc = {"verdict": "no_ground_truth"}
+    elif not mal_shards:
+        loc = {"verdict": "no_malicious"}
+    else:
+        # Stabilization: the earliest recorded round from which every
+        # malicious shard stays rejected through the end of the run.
+        stabilized = None
+        for i in range(len(per_round) - 1, -1, -1):
+            _, _, rej = per_round[i]
+            if all(s in rej for s in mal_shards):
+                stabilized = per_round[i][0]
+            else:
+                break
+        if stabilized is not None and all(
+                s in per_round[-1][2] for s in mal_shards):
+            loc = {"verdict": "localized",
+                   "isolated_shards": mal_shards,
+                   "stabilized_round": stabilized}
+        else:
+            loc = {"verdict": "not_localized",
+                   "stabilized_round": None}
+    out["localization"] = loc
+    return out
+
+
 def fault_recovery(events):
     """Fault/recovery accounting from 'fault' events (core/faults.py +
     the engine watchdog): total injected per kind, quarantined rows,
@@ -252,6 +420,9 @@ def summarize_run(events):
     sec = secagg_summary(events)
     if sec:
         out["secagg"] = sec
+    fx = forensics_summary(events)
+    if fx:
+        out["forensics"] = fx
     hists = [e for e in events if e["kind"] == "selection_hist"]
     if hists:
         out["selection_hist"] = {
@@ -329,6 +500,9 @@ def _print_run(path, s, out):
             out("    group sum norms (last round): "
                 + "  ".join(f"{x:.3f}"
                             for x in sec["group_sum_norms_last"]))
+    fx = s.get("forensics")
+    if fx:
+        _print_forensics(fx, out, indent="  ")
     cc = s.get("compile_cost")
     if cc:
         out(f"  compile & cost ({cc['compile_total_s']:.2f} s total "
@@ -379,12 +553,155 @@ def _print_run(path, s, out):
                                      for k, v in s["stream"].items()))
 
 
+def _print_forensics(fx, out, indent="  "):
+    """Human-readable forensics table (shared by the per-run summary
+    and the 'report forensics' subcommand)."""
+    out(f"{indent}hierarchical forensics over {fx['rounds']} rounds: "
+        f"{fx.get('defense')} tier-1 / {fx.get('tier2_defense')} "
+        f"tier-2, megabatch {fx.get('megabatch')}, placement "
+        f"{fx.get('mal_placement')}")
+    if fx.get("mal_counts") is not None:
+        out(f"{indent}  malicious shards (ground truth): "
+            f"{fx.get('malicious_shards')}  (per-shard counts "
+            f"{fx['mal_counts']})")
+    t2 = fx.get("tier2")
+    if t2:
+        share = "  ".join(f"{s}:{x:.3f}"
+                          for s, x in enumerate(t2["selection_share"]))
+        out(f"{indent}  tier-2 selection share by shard: {share}")
+        rej = "  ".join(f"{s}:{c}" for s, c in
+                        sorted(t2["rejections"].items(),
+                               key=lambda kv: int(kv[0]))) or "none"
+        out(f"{indent}  tier-2 rejections (rounds rejected): {rej}")
+        if "malicious_share" in t2:
+            out(f"{indent}  malicious selection share "
+                f"{t2['malicious_share']:.3f}; all-malicious-rejected "
+                f"rounds {t2['mal_rejected_rounds']}/{t2['rounds']}")
+    loc = fx.get("localization", {})
+    verdict = loc.get("verdict")
+    if verdict == "localized":
+        out(f"{indent}  localization: LOCALIZED from round "
+            f"{loc['stabilized_round']} — tier-2 isolated shard(s) "
+            f"{loc['isolated_shards']}")
+    else:
+        out(f"{indent}  localization: {verdict}")
+    for row in fx.get("tier1", []):
+        out(f"{indent}  tier-1 shard {row['shard']} "
+            f"({row['mal_rows']} malicious rows): top-1 share "
+            f"{row['top1_share']} (row {row['top1_row']}), malicious "
+            f"share {row['malicious_share']}")
+
+
+def forensics_main(argv=None) -> int:
+    """``report forensics`` — the tier-2 selection forensics +
+    colluder-localization verdict over hierarchical runs'
+    'shard_selection' streams (schema v6).  Exit 0 when every given
+    log yields a verdict, 1 when any log carries no shard_selection
+    events (a flat run, or telemetry off — named per file)."""
+    p = argparse.ArgumentParser(
+        prog="attacking_federate_learning_tpu report forensics",
+        description="Tier-2 selection forensics and colluder "
+                    "localization from 'shard_selection' events "
+                    "(hierarchical + groupwise-secagg runs with "
+                    "--telemetry).")
+    p.add_argument("paths", nargs="*", metavar="RUN_JSONL")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (one object keyed by "
+                        "path)")
+    p.add_argument("--skip-bad", action="store_true",
+                   help="tolerate torn/invalid lines")
+    p.add_argument("--events", default=None, metavar="JSONL",
+                   help="append one v6 'forensics' verdict event per "
+                        "analyzed log to this run log")
+    p.add_argument("--run-id", action="append", default=[],
+                   metavar="QUERY",
+                   help="resolve a run through the cross-run registry "
+                        "(repeatable, mixes with explicit paths)")
+    p.add_argument("--run-dir", default="runs",
+                   help="registry location for --run-id resolution")
+    args = p.parse_args(argv)
+
+    paths = list(args.paths)
+    for query in args.run_id:
+        from attacking_federate_learning_tpu.utils.registry import (
+            RunRegistry
+        )
+
+        entry = RunRegistry(args.run_dir).resolve(query)
+        events = entry.get("events")
+        if not isinstance(events, str) or not os.path.exists(events):
+            p.error(f"--run-id {query}: run {entry['run_id']} has no "
+                    f"readable event log (events={events!r})")
+        paths.append(events)
+    if not paths:
+        p.error("nothing to analyze: give RUN_JSONL paths and/or "
+                "--run-id")
+
+    failed = False
+    results = {}
+    for path in paths:
+        fx = forensics_summary(load_events([path],
+                                           skip_bad=args.skip_bad))
+        results[path] = fx
+        if fx is None:
+            failed = True
+    if args.events:
+        import time
+
+        from attacking_federate_learning_tpu.utils.metrics import (
+            SCHEMA_VERSION, validate_event
+        )
+
+        with open(args.events, "a") as f:
+            for path, fx in results.items():
+                if fx is None:
+                    continue
+                loc = fx.get("localization", {})
+                rec = {"kind": "forensics", "v": SCHEMA_VERSION,
+                       "t": round(time.time(), 3), "source": path,
+                       "verdict": loc.get("verdict"),
+                       "rounds": fx["rounds"],
+                       "malicious_shards": fx.get("malicious_shards")}
+                if "stabilized_round" in loc:
+                    rec["stabilized_round"] = loc["stabilized_round"]
+                if "isolated_shards" in loc:
+                    rec["isolated_shards"] = loc["isolated_shards"]
+                t2 = fx.get("tier2", {})
+                if "malicious_share" in t2:
+                    rec["tier2_malicious_share"] = t2["malicious_share"]
+                    rec["mal_rejected_rounds"] = (
+                        t2["mal_rejected_rounds"])
+                validate_event(rec)
+                f.write(json.dumps(rec) + "\n")
+    if args.json:
+        print(json.dumps(results))
+        return 1 if failed else 0
+    for path, fx in results.items():
+        print(f"== {path} ==")
+        if fx is None:
+            print("  no 'shard_selection' events: forensics needs a "
+                  "hierarchical (or groupwise-secagg) run with "
+                  "--telemetry")
+            continue
+        _print_forensics(fx, print)
+    return 1 if failed else 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        import sys
+
+        argv = sys.argv[1:]
+    if argv and argv[0] == "forensics":
+        # 'report forensics' — dispatched before argparse like the
+        # cli.py subcommands, so the summary flag surface stays as-is.
+        return forensics_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="attacking_federate_learning_tpu report",
         description="Summarize structured run JSONLs: selection "
                     "concentration, phase timing, accuracy/ASR "
-                    "trajectories (utils/metrics.py event schema).")
+                    "trajectories, hierarchical forensics "
+                    "(utils/metrics.py event schema).")
     p.add_argument("paths", nargs="*", metavar="RUN_JSONL")
     p.add_argument("--json", action="store_true",
                    help="machine-readable output (one object keyed by "

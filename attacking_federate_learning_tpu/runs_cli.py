@@ -19,7 +19,11 @@ the experiment flag surface stays reference-verbatim).  Verbs:
 - ``runs compare Q...`` — side-by-side metric table over N runs
 - ``runs tag Q TAG``    — attach a resolvable human tag
 - ``runs trace Q``      — export the run's event log as Chrome/Perfetto
-  trace JSON (utils/trace_export.py)
+  trace JSON (utils/trace_export.py; hierarchical runs get the tier-2
+  rejection counter + forensics instants as their own track)
+- ``runs forensics Q``  — tier-2 selection forensics + the colluder-
+  localization verdict over a hierarchical run's schema-v6
+  shard_selection stream (report.py:forensics_summary)
 - ``runs selfcheck``    — CI leg: refresh idempotence + resolvability
   over the current run store (tools/smoke.sh leg 6)
 
@@ -308,6 +312,26 @@ def cmd_trace(reg, args):
     return 0
 
 
+def cmd_forensics(reg, args):
+    """Registry-resolved 'report forensics' (report.py): the tier-2
+    rejection attribution + colluder-localization verdict over a
+    hierarchical run's schema-v6 shard_selection stream."""
+    from attacking_federate_learning_tpu.report import forensics_main
+
+    e = reg.resolve(args.query, args.filter)
+    events = e.get("events")
+    if not isinstance(events, str) or not os.path.exists(events):
+        print(f"run {e['run_id']} has no readable event log "
+              f"(events={events!r})")
+        return 1
+    fargs = [events]
+    if args.json:
+        fargs.append("--json")
+    if args.events:
+        fargs += ["--events", args.events]
+    return forensics_main(fargs)
+
+
 def cmd_selfcheck(reg, args):
     """CI self-check (tools/smoke.sh leg 6): two refreshes must agree
     (incremental refresh is idempotent over an unchanged store), every
@@ -398,6 +422,15 @@ def main(argv=None) -> int:
     sp.add_argument("query")
     sp.add_argument("-o", "--out", default=None)
     sp.set_defaults(fn=cmd_trace)
+    sp = sub.add_parser("forensics",
+                        help="tier-2 selection forensics + colluder "
+                             "localization (hierarchical runs with "
+                             "--telemetry; report.py)")
+    sp.add_argument("query")
+    sp.add_argument("--events", default=None, metavar="JSONL",
+                    help="append the v6 'forensics' verdict event to "
+                         "this run log")
+    sp.set_defaults(fn=cmd_forensics)
     sp = sub.add_parser("selfcheck",
                         help="CI: refresh idempotence + resolvability")
     sp.set_defaults(fn=cmd_selfcheck)

@@ -32,7 +32,13 @@ utils/lifecycle.py); v4 adds the cross-run observatory rollups —
 drift verdict per pinned cell, tools/science_gate.py); v5 adds
 ``secagg`` — one secure-aggregation protocol record per round
 (protocols/secagg.py: masks reconstructed, dropout-recovery flag,
-bitwise sum-check verdict, per-group sum norms under groupwise).
+bitwise sum-check verdict, per-group sum norms under groupwise); v6
+adds the hierarchical forensics kinds — ``shard_selection`` (one
+record per hierarchical round under --telemetry: the stacked per-shard
+tier-1 diagnostics and the tier-2 cross-shard selection/trim
+diagnostics, with the static placement ground truth riding along) and
+``forensics`` (the colluder-localization verdict `report forensics`
+computes from a run's shard_selection stream).
 Readers accept every version; older logs simply never carry the newer
 kinds, and a newer-only kind stamped with an older version is an
 emitter bug, rejected (``KIND_MIN_VERSION``).
@@ -50,8 +56,8 @@ from typing import Optional
 import numpy as np
 
 
-SCHEMA_VERSION = 5
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5)
+SCHEMA_VERSION = 6
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6)
 
 # kind -> required fields.  Producers: core/engine.py (round, eval, asr,
 # profile, stream, defense, attack, selection_hist via RunLogger).
@@ -114,6 +120,21 @@ EVENT_KINDS = {
     # simulated seed-reveal (recovery), and under groupwise the
     # per-group sum norms — the server-visible quantities
     "secagg": {"round"},
+    # --- v6: hierarchical forensics (core/engine.py, report.py) ---------
+    # one record per hierarchical round under --telemetry: the stacked
+    # per-shard tier-1 diagnostics ('shard_*' fields — (S, m) selection
+    # masks/scores, kept fractions) and the tier-2 cross-shard
+    # diagnostics ('tier2_*' fields — (S,) selection mask/scores over
+    # the shard-estimate matrix), plus the static placement ground
+    # truth (mal_counts, megabatch) the forensics layer attributes
+    # against.  Under groupwise secagg only the tier-2 (group-sum-
+    # level) fields appear — per-client rows are not server-visible.
+    "shard_selection": {"round", "defense"},
+    # the colluder-localization verdict 'report forensics' computes
+    # from a run's shard_selection stream (tier-2 rejection
+    # attribution: which shards were rejected, when localization
+    # stabilized, whether the malicious shards were isolated)
+    "forensics": {"verdict"},
 }
 
 # Minimum schema version per kind introduced after v1; an event carrying
@@ -121,7 +142,7 @@ EVENT_KINDS = {
 # older writer cannot know these kinds).
 KIND_MIN_VERSION = {"compile": 2, "cost": 2, "heartbeat": 2,
                     "lifecycle": 3, "registry": 4, "gate": 4,
-                    "secagg": 5}
+                    "secagg": 5, "shard_selection": 6, "forensics": 6}
 
 # Back-compat alias (pre-v3 spelling used by external readers).
 V2_KINDS = {k for k, v in KIND_MIN_VERSION.items() if v == 2}

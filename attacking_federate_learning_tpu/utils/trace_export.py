@@ -19,6 +19,12 @@ directly:
   become instant ("i") events with their payload in args;
 - **heartbeats** become counter ("C") tracks (rss_mb, rounds_per_s) —
   a stalled run is a flat-lining counter;
+- **shard_selection** rounds (schema v6, hierarchical forensics)
+  become a ``tier2_rejected`` counter (how many shard estimates the
+  cross-shard reduction rejected that round) plus per-round instants
+  on a "tier-2 forensics" track naming the rejected set, and a
+  **forensics** verdict becomes an instant on the same track — the
+  colluder-localization story as a timeline;
 - the end-of-run **profile** summary (PhaseTimer) is laid out as
   sequential "X" spans on a phases track (aggregates, not real
   intervals — count/mean ride in args).
@@ -50,15 +56,17 @@ _TID_COMPILES = 3
 _TID_LIFECYCLE = 4
 _TID_FAULTS = 5
 _TID_PHASES = 6
+_TID_FORENSICS = 7
 
 _TID_NAMES = {_TID_ROUNDS: "rounds", _TID_EVALS: "evals",
               _TID_COMPILES: "compiles", _TID_LIFECYCLE: "lifecycle",
-              _TID_FAULTS: "faults", _TID_PHASES: "phases (aggregate)"}
+              _TID_FAULTS: "faults", _TID_PHASES: "phases (aggregate)",
+              _TID_FORENSICS: "tier-2 forensics"}
 
 _INSTANT_KINDS = {"eval": _TID_EVALS, "asr": _TID_EVALS,
                   "lifecycle": _TID_LIFECYCLE, "fault": _TID_FAULTS,
                   "stream": _TID_LIFECYCLE, "registry": _TID_LIFECYCLE,
-                  "gate": _TID_LIFECYCLE}
+                  "gate": _TID_LIFECYCLE, "forensics": _TID_FORENSICS}
 
 # Event-record fields that are bookkeeping, not payload.
 _META_FIELDS = {"kind", "t", "v"}
@@ -152,9 +160,35 @@ def events_to_trace(events, name: str = "run") -> dict:
                                        "mean_ms": row.get("mean_ms"),
                                        "aggregate": True}})
                 cursor += total
+        elif kind == "shard_selection":
+            # Hierarchical forensics (schema v6): the tier-2 rejection
+            # attribution as a timeline — a counter of how many shard
+            # estimates the cross-shard reduction rejected this round,
+            # plus an instant naming the rejected set (report.py owns
+            # the attribution rule; mean/median tier-2 kernels expose
+            # no selection and draw no point).
+            from attacking_federate_learning_tpu.report import (
+                tier2_attribution
+            )
+            mass, rejected = tier2_attribution(e)
+            if mass is not None:
+                trace.append({"name": "tier2_rejected", "ph": "C",
+                              "pid": pid, "tid": 0, "ts": _us(t),
+                              "args": {"tier2_rejected":
+                                       float(len(rejected))}})
+                args = _args_of(e)
+                args["rejected_shards"] = ",".join(
+                    str(s) for s in sorted(rejected)) or "none"
+                trace.append({"name": f"tier2 reject "
+                                      f"{sorted(rejected)}",
+                              "ph": "i", "pid": pid,
+                              "tid": _TID_FORENSICS, "ts": _us(t),
+                              "s": "t", "args": args})
         elif kind in _INSTANT_KINDS:
             label = kind if kind != "lifecycle" else (
                 f"lifecycle:{e.get('phase', '?')}")
+            if kind == "forensics":
+                label = f"forensics:{e.get('verdict', '?')}"
             trace.append({"name": label, "ph": "i", "pid": pid,
                           "tid": _INSTANT_KINDS[kind], "ts": _us(t),
                           "s": "t", "args": _args_of(e)})

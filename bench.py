@@ -744,6 +744,79 @@ def main():
             recap(f"hybrid D-marshal: (10240,10240) f32 pure_callback "
                   f"on {dev.platform}: {1e3 * s_marshal:.1f} ms")
             del D10
+        # Hierarchical telemetry overhead at the 10,240-client
+        # memproof point (ISSUE 8): the same n/m/d the perf-gate
+        # memproof pins, Krum both tiers — hier span vs hier TELE span
+        # wall clock over a 2-round scanned span (host fetch of the
+        # stacked diagnostics included: that IS the telemetry cost
+        # model) plus each program's static temp bytes, so the BENCH
+        # record says what --telemetry costs where the engine is
+        # actually sized to run.
+        with phase("hier-tele-overhead", 600):
+            from attacking_federate_learning_tpu.config import (
+                ExperimentConfig
+            )
+            from attacking_federate_learning_tpu.core.engine import (
+                FederatedExperiment
+            )
+            from attacking_federate_learning_tpu.data.datasets import (
+                load_dataset
+            )
+            from attacking_federate_learning_tpu.utils.costs import (
+                compiled_cost_facts
+            )
+
+            n_mp, m_mp = N_NORTH, 512
+            ds_mp = load_dataset("SYNTH_MNIST", seed=0,
+                                 synth_train=n_mp, synth_test=64)
+            res_ht = {"clients": n_mp, "megabatch": m_mp}
+            for tele in (False, True):
+                cfg_ht = ExperimentConfig(
+                    dataset="SYNTH_MNIST", users_count=n_mp,
+                    mal_prop=0.24, batch_size=1, epochs=4, test_step=2,
+                    seed=0, synth_train=n_mp, synth_test=64,
+                    defense="Krum", aggregation="hierarchical",
+                    megabatch=m_mp, tier2_defense="Krum",
+                    telemetry=tele)
+                exp_ht = FederatedExperiment(cfg_ht, dataset=ds_mp)
+                tag = "tele_span" if tele else "span"
+                if tele:
+                    lowered = exp_ht._tele_span.lower(
+                        exp_ht.state, jnp.asarray(0, jnp.int32), 2)
+                else:
+                    lowered = exp_ht._fused_span.lower(
+                        exp_ht.state, jnp.asarray(0, jnp.int32),
+                        jnp.asarray(2, jnp.int32))
+                facts = compiled_cost_facts(lowered.compile())
+                res_ht[f"{tag}_temp_bytes"] = int(facts["temp_bytes"])
+                exp_ht.run_span(0, 2)          # compile + warm
+                fetch1(exp_ht.state.weights)
+                t0 = time.perf_counter()
+                exp_ht.run_span(2, 2)
+                fetch1(exp_ht.state.weights)
+                if tele and exp_ht.last_span_telemetry is not None:
+                    # The once-per-eval-interval host fetch of the
+                    # stacked diagnostics is part of what telemetry
+                    # costs — time it with the span.
+                    jax.tree.map(np.asarray,
+                                 exp_ht.last_span_telemetry[1])
+                res_ht[f"{tag}_s"] = round(time.perf_counter() - t0, 3)
+                del exp_ht
+            res_ht["overhead_pct"] = round(
+                100.0 * (res_ht["tele_span_s"] - res_ht["span_s"])
+                / max(res_ht["span_s"], 1e-9), 1)
+            res_ht["temp_overhead_pct"] = round(
+                100.0 * (res_ht["tele_span_temp_bytes"]
+                         - res_ht["span_temp_bytes"])
+                / max(res_ht["span_temp_bytes"], 1), 1)
+            recap(f"hier-tele overhead @ {n_mp} (m={m_mp}, Krum/Krum, "
+                  f"2-round span): span {res_ht['span_s']:.1f} s vs "
+                  f"tele {res_ht['tele_span_s']:.1f} s "
+                  f"({res_ht['overhead_pct']:+.1f}%); temp "
+                  f"{res_ht['span_temp_bytes'] / 1e6:.0f} -> "
+                  f"{res_ht['tele_span_temp_bytes'] / 1e6:.0f} MB "
+                  f"({res_ht['temp_overhead_pct']:+.1f}%)")
+            RESULT["hier_telemetry"] = res_ht
 
     # --- secondary: full FL round throughput (stderr diagnostic) --------
     with phase("fl-throughput", 600):

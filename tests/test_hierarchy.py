@@ -396,9 +396,12 @@ def test_hier_config_validation(tmp_path):
 
 
 def test_hier_engine_rejects_unsupported_combos(tmp_path):
+    # NOTE (ISSUE 8): telemetry/log_round_stats are no longer in this
+    # matrix — they are supported hierarchical compositions now (the
+    # per-shard diagnostics ride the scan as (S, m) stacks); the
+    # remaining rejections pin only the still-unsupported set.
     ds = _dataset()
     for kw, match in (
-            (dict(telemetry=True), "telemetry"),
             (dict(participation=0.5), "participation"),
             (dict(data_placement="host_stream"), "device"),
             (dict(faults=C.FaultConfig(dropout=0.2)), "fault"),
@@ -414,6 +417,216 @@ def test_hier_engine_rejects_unsupported_combos(tmp_path):
         FederatedExperiment(
             _hier(tmp_path, defense="Bulyan", tier1_corrupted=2),
             attacker=DriftAttack(1.0), dataset=ds)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: per-shard telemetry, tier-2 forensics, colluder localization
+
+def test_two_tier_telemetry_bit_matches_flat_kernels():
+    """two_tier_aggregate(telemetry=True): each stacked tier-1
+    diagnostics row is BIT-FOR-BIT the flat kernel's telemetry on that
+    shard's sub-matrix (the ISSUE 8 acceptance contract), the tier-2
+    diag is the shard_* entry's (S,) selection record, and the
+    aggregate itself is bit-equal to the telemetry-off call."""
+    n, m, f = 32, 8, 3
+    pl = make_placement(n, f, m, "concentrated")
+    f1 = tier1_assumed(f, pl.num_shards)
+    f2 = max(tier2_assumed(f, m), 1)
+    rng = np.random.default_rng(11)
+    G = jnp.asarray(rng.standard_normal((n, 40)).astype(np.float32))
+    t2 = TIER2_DEFENSES["Krum"]
+    with jax.disable_jit():
+        plain = two_tier_aggregate(G, pl, krum, t2, f1, f2)
+        agg, t1d, t2d = two_tier_aggregate(G, pl, krum, t2, f1, f2,
+                                           telemetry=True)
+        # Per-shard rows == the flat kernel's telemetry on the same
+        # sub-matrix (op-identical dispatch -> bitwise).
+        for s in range(pl.num_shards):
+            _, want = krum(G[jnp.asarray(pl.grid[s])], m, f1,
+                           telemetry=True)
+            for k in want:
+                np.testing.assert_array_equal(
+                    np.asarray(t1d[k][s]), np.asarray(want[k]), err_msg=k)
+        # Tier-2 record: one-hot over the shard axis.
+        _, want2 = krum(jnp.stack([
+            krum(G[jnp.asarray(pl.grid[s])], m, f1)
+            for s in range(pl.num_shards)]).astype(jnp.float32),
+            pl.num_shards, f2, telemetry=True)
+        np.testing.assert_array_equal(
+            np.asarray(t2d["selection_mask"]),
+            np.asarray(want2["selection_mask"]))
+    np.testing.assert_array_equal(np.asarray(agg), np.asarray(plain))
+    assert np.asarray(t1d["selection_mask"]).shape == (pl.num_shards, m)
+    assert np.asarray(t2d["selection_mask"]).shape == (pl.num_shards,)
+
+
+def test_shard_kernels_telemetry_passthrough():
+    """Every TIER2_DEFENSES entry takes telemetry= and returns a
+    bit-identical aggregate plus a fixed-shape (S,)/() diag."""
+    rng = np.random.default_rng(5)
+    E = jnp.asarray(rng.standard_normal((7, 24)).astype(np.float32))
+    for name, fn in sorted(TIER2_DEFENSES.items()):
+        plain = np.asarray(fn(E, 7, 1))
+        agg, diag = fn(E, 7, 1, telemetry=True)
+        np.testing.assert_array_equal(plain, np.asarray(agg),
+                                      err_msg=name)
+        for k, v in diag.items():
+            assert np.asarray(v).shape in ((), (7,)), (name, k)
+    assert TIER2_DEFENSES["NoDefense"](E, 7, 0, telemetry=True)[1] == {}
+
+
+def test_hier_telemetry_on_off_bit_identical_and_hlo_clean(tmp_path):
+    """Engine acceptance: telemetry must be a pure observer of the
+    hierarchical round — on/off final weights bit-equal (span path),
+    and the telemetry-OFF compiled round carries none of the stacked
+    (S, m) diagnostics tensors (the structural half of the
+    byte-identity pin; tools/perf_gate.py's hier cells staying
+    byte-exact is the other half)."""
+    ds = _dataset()
+    off = FederatedExperiment(_hier(tmp_path, defense="Krum", epochs=4),
+                              attacker=DriftAttack(1.0), dataset=ds)
+    off.run_span(0, 4)
+    on = FederatedExperiment(
+        _hier(tmp_path, defense="Krum", epochs=4, telemetry=True),
+        attacker=DriftAttack(1.0), dataset=ds)
+    on.run_span(0, 4)
+    np.testing.assert_array_equal(np.asarray(off.state.weights),
+                                  np.asarray(on.state.weights))
+    np.testing.assert_array_equal(np.asarray(off.state.velocity),
+                                  np.asarray(on.state.velocity))
+    # Structural HLO pin: S=3, m=4 — the stacked per-shard mask/score/
+    # norm tensors are f32[3,4]; the off program must not contain one
+    # (compiled-HLO text, the wire_hlo_facts convention).
+    text_off = off._fused_round.lower(
+        off.state, jnp.asarray(0, jnp.int32)).compile().as_text()
+    text_on = on._fused_round.lower(
+        on.state, jnp.asarray(0, jnp.int32)).compile().as_text()
+    assert "f32[3,4]" not in text_off
+    assert "f32[3,4]" in text_on          # non-vacuous
+    # Stacked telemetry shapes: (rounds, S, m) tier-1, (rounds, S)
+    # tier-2, from the span's one fetch.
+    t0, stacked = on.last_span_telemetry
+    host = jax.tree.map(np.asarray, stacked)
+    assert host["shard_selection_mask"].shape == (4, 3, 4)
+    assert host["tier2_selection_mask"].shape == (4, 3)
+    # Per-round tier-1 masks are one-hot per shard (Krum), and the
+    # tier-2 mask is one-hot over shards.
+    assert (host["shard_selection_mask"].sum(axis=2) == 1.0).all()
+    assert (host["tier2_selection_mask"].sum(axis=1) == 1.0).all()
+
+
+def test_hier_round_stats(tmp_path):
+    """--round-stats on a hierarchical run: per-round scalar diag with
+    the flat keys, computed exactly from the (S, m) norm stack (same n
+    values, different reduction shape)."""
+    ds = _dataset()
+    exp = FederatedExperiment(
+        _hier(tmp_path, defense="Krum", log_round_stats=True),
+        attacker=DriftAttack(1.0), dataset=ds)
+    exp.run_round(0)
+    diag = {k: float(v) for k, v in exp.last_round_stats.items()}
+    assert set(diag) == {"grad_norm_mean", "grad_norm_max",
+                         "grad_norm_min", "update_norm", "faded_lr"}
+    assert diag["grad_norm_max"] >= diag["grad_norm_mean"] >= (
+        diag["grad_norm_min"]) > 0
+
+
+def test_hier_tele_cost_entry(tmp_path):
+    """The telemetry engine ledgers its span under hier_tele_span —
+    the perf-gate hier_krum_tele cell's entry point."""
+    ds = _dataset()
+    exp = FederatedExperiment(
+        _hier(tmp_path, defense="Krum", telemetry=True),
+        attacker=DriftAttack(1.0), dataset=ds)
+    led = exp.cost_report()
+    names = [r.name for r in led.records]
+    assert "hier_tele_span" in names and not led.errors
+
+
+def test_hier_telemetry_events_and_forensics_localization(tmp_path):
+    """Satellite acceptance: a 10-round concentrated-placement Krum
+    run emits one schema-v6 'shard_selection' event per round whose
+    tier-2 mask rejects the colluder shard, and `report forensics`
+    localizes it — the verdict NAMES the malicious shard(s)."""
+    from attacking_federate_learning_tpu import report
+
+    ds = load_dataset(C.SYNTH_MNIST_HARD, seed=0)
+    cfg = ExperimentConfig(
+        dataset=C.SYNTH_MNIST_HARD, users_count=20, mal_prop=0.2,
+        batch_size=64, epochs=10, test_step=10, num_std=1.5,
+        defense="Krum", seed=0, aggregation="hierarchical",
+        megabatch=5, mal_placement="concentrated", telemetry=True,
+        log_dir=str(tmp_path / "logs"), run_dir=str(tmp_path / "runs"))
+    exp = FederatedExperiment(cfg, attacker=make_attacker(cfg, dataset=ds),
+                              dataset=ds)
+    with RunLogger(cfg, None, cfg.log_dir, jsonl_name="fx") as logger:
+        exp.run(logger)
+    path = os.path.join(cfg.log_dir, "fx.jsonl")
+    events = report.load_events([path])       # schema-validates v6
+    ss = [e for e in events if e["kind"] == "shard_selection"]
+    assert len(ss) == 10
+    assert all(e["v"] == 6 for e in ss)
+    assert ss[0]["mal_counts"] == [4, 0, 0, 0]
+    # Placement packs all 4 colluders into shard 0; tier-2 Krum must
+    # reject its estimate (zero selection mass) every round — the
+    # measured GRID round-6 rescue, now attributed.
+    for e in ss:
+        assert e["tier2_selection_mask"][0] == 0.0
+    fx = report.forensics_summary(events)
+    assert fx["malicious_shards"] == [0]
+    assert fx["localization"]["verdict"] == "localized"
+    assert fx["localization"]["isolated_shards"] == [0]
+    assert fx["tier2"]["mal_rejected_rounds"] == 10
+    assert fx["tier2"]["malicious_share"] == 0.0
+    # Tier-1 concentration: the colluder shard's selection collapses
+    # onto its own malicious rows (the duplicate-collapse mechanism).
+    row0 = next(r for r in fx["tier1"] if r["shard"] == 0)
+    assert row0["malicious_share"] > 0.9
+    # The CLI surface agrees: `report forensics` exits 0 and the
+    # emitted v6 'forensics' event validates.
+    ev_path = str(tmp_path / "fx_verdict.jsonl")
+    assert report.forensics_main([path, "--events", ev_path]) == 0
+    rec = json.loads(open(ev_path).read().strip())
+    assert rec["kind"] == "forensics" and rec["v"] == 6
+    assert rec["verdict"] == "localized"
+    assert rec["isolated_shards"] == [0]
+    # A flat log (no shard_selection events) is a named failure.
+    flat = str(tmp_path / "flat.jsonl")
+    with open(flat, "w") as f:
+        f.write(json.dumps({"kind": "round", "round": 0, "v": 1}) + "\n")
+    assert report.forensics_main([flat]) == 1
+
+
+def test_trace_export_forensics_track(tmp_path):
+    """Synthetic shard_selection/forensics events land as the tier-2
+    rejection counter + forensics instants, and the exported trace
+    validates."""
+    from attacking_federate_learning_tpu.utils.trace_export import (
+        events_to_trace, validate_trace
+    )
+
+    events = [
+        {"kind": "shard_selection", "round": 0, "defense": "Krum",
+         "tier2_selection_mask": [0.0, 1.0, 0.0], "v": 6, "t": 1.0},
+        {"kind": "shard_selection", "round": 1, "defense": "Krum",
+         "tier2_kept_fraction": [0.05, 0.9, 0.85], "v": 6, "t": 2.0},
+        {"kind": "shard_selection", "round": 2, "defense": "NoDefense",
+         "v": 6, "t": 3.0},                   # no attribution: no point
+        {"kind": "forensics", "verdict": "localized",
+         "isolated_shards": [0], "v": 6, "t": 4.0},
+    ]
+    trace = events_to_trace(events)
+    assert validate_trace(trace) == []
+    counters = [e for e in trace["traceEvents"]
+                if e["name"] == "tier2_rejected"]
+    assert [e["args"]["tier2_rejected"] for e in counters] == [2.0, 1.0]
+    instants = [e for e in trace["traceEvents"]
+                if e["name"].startswith("tier2 reject")]
+    assert len(instants) == 2
+    assert instants[0]["args"]["rejected_shards"] == "0,2"
+    assert instants[1]["args"]["rejected_shards"] == "0"
+    assert any(e["name"] == "forensics:localized"
+               for e in trace["traceEvents"])
 
 
 def test_cli_hier_flags_roundtrip():

@@ -333,7 +333,11 @@ def test_secagg_preempt_resume_bit_for_bit(tmp_path):
 # satellite 3: the loud-rejection message contract
 
 # (cfg_kwargs, message fragment naming the offending flag).  Config-level
-# rejections raise at ExperimentConfig construction.
+# rejections raise at ExperimentConfig construction.  (ISSUE 8 relaxed
+# the matrix: --telemetry/--round-stats now compose with groupwise —
+# tier-2 selection over group sums is server-visible — so only the
+# VANILLA rows stay pinned here: one masked cohort sum has nothing
+# per-client or per-group to observe.)
 _CONFIG_REJECTS = [
     (dict(secagg="vanilla", defense="Krum"), "--secagg vanilla"),
     (dict(secagg="vanilla", defense="Bulyan"), "--tier2-defense"),
@@ -355,10 +359,9 @@ _CONFIG_REJECTS = [
     (dict(secagg="sideways"), "--secagg"),
 ]
 
-# PR 6's hierarchical rejections, pinned to flag-naming messages too.
+# PR 6's hierarchical rejections, pinned to flag-naming messages too
+# (minus telemetry/round-stats — supported since ISSUE 8).
 _ENGINE_REJECTS = [
-    (dict(aggregation="hierarchical", megabatch=4, telemetry=True),
-     "telemetry"),
     (dict(aggregation="hierarchical", megabatch=4,
           faults=FaultConfig(dropout=0.2)), "fault"),
     (dict(aggregation="hierarchical", megabatch=4, participation=0.5),
@@ -386,6 +389,68 @@ def test_hier_engine_rejections_name_the_flag(tmp_path, kw, match):
         FederatedExperiment(_cfg(tmp_path, defense="Krum", **kw),
                             attacker=DriftAttack(1.0),
                             dataset=_dataset())
+
+
+def test_groupwise_telemetry_composition(tmp_path):
+    """ISSUE 8: --telemetry now composes with --secagg groupwise.  The
+    observable surface is the GROUP-SUM level only: 'shard_selection'
+    events carry tier-2 fields, never per-client stacks (no
+    shard_grad_norms, no shard_selection_mask — tier-1 is NoDefense
+    over rows the threat model hides); 'secagg' events grow the
+    per-group envelope (cosine-to-mean next to the sum norms); and the
+    run's weights stay bit-equal to the telemetry-off twin."""
+    ds = _dataset()
+
+    def cfg(**kw):
+        return _cfg(tmp_path, secagg="groupwise",
+                    aggregation="hierarchical", megabatch=4,
+                    tier2_defense="Krum", **kw)
+
+    off = FederatedExperiment(cfg(), attacker=DriftAttack(1.0),
+                              dataset=ds)
+    off.run_span(0, 6)
+    c_on = cfg(telemetry=True)
+    on = FederatedExperiment(c_on, attacker=DriftAttack(1.0), dataset=ds)
+    with RunLogger(c_on, None, c_on.log_dir,
+                   jsonl_name="gw_tele") as logger:
+        on.run(logger)
+    np.testing.assert_array_equal(np.asarray(off.state.weights),
+                                  np.asarray(on.state.weights))
+    events = _events(tmp_path / "logs" / "gw_tele.jsonl")
+    ss = [e for e in events if e.get("kind") == "shard_selection"]
+    assert len(ss) == 6 and all(e["v"] == 6 for e in ss)
+    for e in ss:
+        assert len(e["tier2_selection_mask"]) == 3   # S groups
+        # Per-client stacks must NOT appear under secagg: the server
+        # never holds the rows they would be computed from.
+        assert not any(k.startswith("shard_") for k in e)
+    sec = [e for e in events if e.get("kind") == "secagg"]
+    assert len(sec) == 6
+    for e in sec:
+        assert len(e["group_cos_to_mean"]) == 3
+        assert all(-1.0 - 1e-5 <= x <= 1.0 + 1e-5
+                   for x in e["group_cos_to_mean"])
+    # Forensics runs on the groupwise stream too (tier-2-only view).
+    from attacking_federate_learning_tpu.report import forensics_summary
+    fx = forensics_summary(events)
+    assert fx is not None and fx["tier2"]["rounds"] == 6
+    assert "tier1" not in fx
+
+
+def test_groupwise_round_stats_composition(tmp_path):
+    """--round-stats under groupwise reports group-sum norm stats (the
+    server-visible quantity), not per-client gradient norms."""
+    ds = _dataset()
+    exp = FederatedExperiment(
+        _cfg(tmp_path, secagg="groupwise", aggregation="hierarchical",
+             megabatch=4, tier2_defense="Krum", log_round_stats=True),
+        attacker=DriftAttack(1.0), dataset=ds)
+    exp.run_round(0)
+    diag = {k: float(v) for k, v in exp.last_round_stats.items()}
+    assert set(diag) == {"group_sum_norm_mean", "group_sum_norm_max",
+                         "group_sum_norm_min", "update_norm",
+                         "faded_lr"}
+    assert diag["group_sum_norm_max"] >= diag["group_sum_norm_mean"] > 0
 
 
 def test_secagg_rejects_nonfusable_attacker(tmp_path):

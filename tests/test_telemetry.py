@@ -287,6 +287,11 @@ def test_schema_roundtrip_every_engine_kind(tmp_path):
         # v4: the science gate's verdict kind (tools/science_gate.py
         # emits these; synthesized here like the heartbeat above).
         logger.record(kind="gate", cell="krum_alie05", status="pass")
+        # v6: the forensics verdict kind (report.py forensics_main
+        # emits these; synthesized like the gate record above — the
+        # real emission path is covered in tests/test_hierarchy.py).
+        logger.record(kind="forensics", verdict="localized",
+                      isolated_shards=[0])
         # v3: a journaled run emits the 'lifecycle' kind from the
         # engine itself (start/complete; utils/lifecycle.py) — and, as
         # of v4, the run-finish 'registry' stamp.
@@ -295,7 +300,15 @@ def test_schema_roundtrip_every_engine_kind(tmp_path):
         path4 = logger.jsonl_path
     with open(path4) as f:
         ev4 = [json.loads(line) for line in f]
-    for rec in ev1 + ev2 + ev3 + ev4:
+    # Run 5: hierarchical + secagg — the v5 'secagg' and v6
+    # 'shard_selection' kinds from a real engine run (groupwise
+    # tier-2 Krum with telemetry, core/engine.py hier tele span).
+    cfg5 = _tele_cfg(tmp_path, users_count=12, mal_prop=0.25,
+                     defense="NoDefense", epochs=3, test_step=3,
+                     secagg="groupwise", aggregation="hierarchical",
+                     megabatch=4, tier2_defense="Krum", telemetry=True)
+    _, ev5 = _run(cfg5, tmp_path, "roundtrip5")
+    for rec in ev1 + ev2 + ev3 + ev4 + ev5:
         validate_event(rec)
         assert rec["v"] == SCHEMA_VERSION
         seen.add(rec["kind"])

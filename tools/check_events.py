@@ -13,13 +13,17 @@ degrade transitions of utils/lifecycle.py — plus v4's cross-run
 observatory kinds: 'registry' run-finish stamps, utils/registry.py,
 and 'gate' behavioral-drift verdicts, tools/science_gate.py — plus
 v5's 'secagg' kind: one secure-aggregation protocol record per round,
-protocols/secagg.py).  An
+protocols/secagg.py — plus v6's hierarchical-forensics kinds:
+'shard_selection' per-round tier-1/tier-2 selection records from
+hierarchical rounds under --telemetry, core/engine.py, and
+'forensics' colluder-localization verdicts, report.py).  An
 event stamped with a
 version this reader does not know is reported as "produced by a newer
 writer" — a clear per-line error, never a KeyError — and a newer-only
 kind stamped with an older version is flagged as an emitter bug
 (utils/metrics.py:validate_event owns both rules via
-KIND_MIN_VERSION).
+KIND_MIN_VERSION; the v6-kind-stamped-v5 rule mirrors the v2
+precedent).
 
 Usage:
     python tools/check_events.py logs/*.jsonl

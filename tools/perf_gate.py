@@ -65,6 +65,16 @@ CELLS = {
     "hier_bulyan": dict(defense="Bulyan", aggregation="hierarchical",
                         users_count=24, mal_prop=0.125, megabatch=8,
                         tier2_defense="TrimmedMean"),
+    # ISSUE 8: the hierarchical TELEMETRY cost cell — the telemetry
+    # engine's hier_round / hier_tele_span with the per-shard + tier-2
+    # diagnostics stacked through the scan, so the telemetry COST
+    # gates like everything else.  The telemetry-OFF hot path is
+    # pinned by the hier_krum/hier_bulyan cells above staying
+    # byte-exact (telemetry is a trace-time flag; any residue in the
+    # off path moves their FLOPs/bytes and fails the gate).
+    "hier_krum_tele": dict(defense="Krum", aggregation="hierarchical",
+                           users_count=12, mal_prop=0.25, megabatch=4,
+                           telemetry=True),
 }
 
 EXACT = ("flops", "bytes_accessed", "argument_bytes", "output_bytes")

@@ -2,16 +2,17 @@
 # One-liner CI smoke: event-schema validation + fault matrix + crash
 # matrix + perf gate (incl. hierarchical memproof + secagg wireproof) +
 # science gate + registry selfcheck + hierarchical-aggregation smoke +
-# secure-aggregation smoke.
+# secure-aggregation smoke + hierarchical-telemetry/forensics smoke.
 #
-#   bash tools/smoke.sh            # all eight, CPU-pinned
+#   bash tools/smoke.sh            # all nine, CPU-pinned
 #   bash tools/smoke.sh --fast     # skip the fault + crash matrices
 #                                  # (the two slowest legs)
 #
 # Legs (each independently CI-wired through tests/ as well):
 #   1. tools/check_events.py over every run JSONL in logs/ (schema
-#      v1-v4: round/eval/.../fault, compile/cost/heartbeat, lifecycle,
-#      registry/gate) — skipped when logs/ has no .jsonl yet;
+#      v1-v6: round/eval/.../fault, compile/cost/heartbeat, lifecycle,
+#      registry/gate, secagg, shard_selection/forensics) — skipped
+#      when logs/ has no .jsonl yet;
 #   2. tools/fault_matrix.py — 5-round fault x defense sweep, emitted
 #      'fault' events diffed against the host replay of the schedule;
 #   3. tools/crash_matrix.py — supervised preempt/resume at a seeded
@@ -35,7 +36,12 @@
 #      a mask-reconstruction round with the bitwise sum check passing)
 #      and a 5-round journaled --secagg groupwise x tier-2 Krum run
 #      (protocols/secagg.py), then the same journal audit plus a
-#      'secagg'-event audit over the private run logs.
+#      'secagg'-event audit over the private run logs;
+#   9. hierarchical-telemetry forensics smoke — a 5-round journaled
+#      hierarchical x Krum run with --telemetry (schema-v6
+#      'shard_selection' events), check_events over its private log,
+#      'report forensics' exit-0, and a 'runs trace' export (the
+#      exporter validates the trace before writing).
 #
 # Exit: nonzero if any leg fails.  Always CPU (the gates' baselines are
 # CPU artifacts, and the matrices must not touch a TPU capture).
@@ -50,32 +56,32 @@ fail=0
 shopt -s nullglob
 jsonls=(logs/*.jsonl)
 if [ ${#jsonls[@]} -gt 0 ]; then
-    echo "== smoke 1/8: check_events (${#jsonls[@]} logs) =="
+    echo "== smoke 1/9: check_events (${#jsonls[@]} logs) =="
     python tools/check_events.py "${jsonls[@]}" || fail=1
 else
-    echo "== smoke 1/8: check_events — no logs/*.jsonl yet, skipped =="
+    echo "== smoke 1/9: check_events — no logs/*.jsonl yet, skipped =="
 fi
 
 crash_work=""
 if [ "${1:-}" != "--fast" ]; then
-    echo "== smoke 2/8: fault_matrix =="
+    echo "== smoke 2/9: fault_matrix =="
     python tools/fault_matrix.py || fail=1
-    echo "== smoke 3/8: crash_matrix (supervised preempt/resume) =="
+    echo "== smoke 3/9: crash_matrix (supervised preempt/resume) =="
     # Keep the matrix's run stores: leg 6 registry-checks them.
     crash_work="$(mktemp -d -t crash_matrix_XXXXXX)"
     python tools/crash_matrix.py --workdir "$crash_work" || fail=1
 else
-    echo "== smoke 2/8: fault_matrix — skipped (--fast) =="
-    echo "== smoke 3/8: crash_matrix — skipped (--fast) =="
+    echo "== smoke 2/9: fault_matrix — skipped (--fast) =="
+    echo "== smoke 3/9: crash_matrix — skipped (--fast) =="
 fi
 
-echo "== smoke 4/8: perf_gate (+ hierarchical memproof) =="
+echo "== smoke 4/9: perf_gate (+ hierarchical memproof) =="
 python tools/perf_gate.py --memproof || fail=1
 
-echo "== smoke 5/8: science_gate (behavioral drift) =="
+echo "== smoke 5/9: science_gate (behavioral drift) =="
 python tools/science_gate.py || fail=1
 
-echo "== smoke 6/8: runs selfcheck (registry) =="
+echo "== smoke 6/9: runs selfcheck (registry) =="
 python -m attacking_federate_learning_tpu.cli runs selfcheck || fail=1
 if [ -n "$crash_work" ]; then
     # The registry over the crash matrix's preempt/resume artifacts:
@@ -92,7 +98,7 @@ if [ -n "$crash_work" ]; then
     rm -rf "$crash_work"
 fi
 
-echo "== smoke 7/8: hierarchical aggregation (journaled, audited) =="
+echo "== smoke 7/9: hierarchical aggregation (journaled, audited) =="
 hier_work="$(mktemp -d -t hier_smoke_XXXXXX)"
 for def in Krum TrimmedMean; do
     python -m attacking_federate_learning_tpu.cli \
@@ -118,7 +124,7 @@ sys.exit(bad)
 PY
 rm -rf "$hier_work"
 
-echo "== smoke 8/8: secure aggregation (journaled, audited) =="
+echo "== smoke 8/9: secure aggregation (journaled, audited) =="
 sa_work="$(mktemp -d -t secagg_smoke_XXXXXX)"
 # vanilla: one dropout-rate high enough that the 5-round seeded run is
 # guaranteed (and pinned by the audit below) to include at least one
@@ -166,6 +172,43 @@ for rid in ("secagg_vanilla_smoke", "secagg_groupwise_smoke"):
 sys.exit(bad)
 PY
 rm -rf "$sa_work"
+
+echo "== smoke 9/9: hierarchical telemetry + forensics (journaled) =="
+fx_work="$(mktemp -d -t hier_tele_smoke_XXXXXX)"
+# 5-round journaled hierarchical x Krum run with --telemetry: the run
+# must emit one schema-v6 'shard_selection' event per round.
+python -m attacking_federate_learning_tpu.cli \
+    -d Krum -s SYNTH_MNIST -n 12 -m 0.25 -c 16 -e 5 \
+    --synth-train 256 --synth-test 64 \
+    --aggregation hierarchical --megabatch 4 --telemetry \
+    --journal --run-id hier_tele_smoke --no-checkpoint \
+    --log-dir "$fx_work/logs" --run-dir "$fx_work/runs" \
+    > /dev/null || fail=1
+# Event audit: the private log validates (v6 'shard_selection' events
+# included) and carries exactly one per round.
+python tools/check_events.py "$fx_work/logs/hier_tele_smoke.jsonl" \
+    || fail=1
+python - "$fx_work" <<'PY' || fail=1
+import json, os, sys
+events = [json.loads(line) for line in
+          open(os.path.join(sys.argv[1], "logs",
+                            "hier_tele_smoke.jsonl"))]
+ss = [e for e in events if e.get("kind") == "shard_selection"]
+ok = (len(ss) == 5 and all(e.get("v") == 6 for e in ss)
+      and all("tier2_selection_mask" in e for e in ss))
+print(f"  shard_selection events: {len(ss)}/5 "
+      f"({'ok' if ok else 'FAIL'})")
+sys.exit(0 if ok else 1)
+PY
+# 'report forensics' must produce a verdict (exit 0) on the run log.
+python -m attacking_federate_learning_tpu.cli report forensics \
+    "$fx_work/logs/hier_tele_smoke.jsonl" || fail=1
+# 'runs trace' export over the same run — export_trace validates the
+# trace-event JSON (tier-2 forensics track included) before writing.
+python -m attacking_federate_learning_tpu.cli runs \
+    --run-dir "$fx_work/runs" --bench '' --progress '' \
+    trace hier_tele_smoke -o "$fx_work/trace.json" || fail=1
+rm -rf "$fx_work"
 
 if [ $fail -ne 0 ]; then
     echo "SMOKE FAILED"
