@@ -19,7 +19,15 @@ def _make_backdoor(cfg, dataset=None):
     return BackdoorAttack(cfg, dataset=dataset)
 
 
+def _make_backdoor_timed(cfg, dataset=None):
+    from attacking_federate_learning_tpu.attacks.backdoor import (
+        TimedBackdoorAttack
+    )
+    return TimedBackdoorAttack(cfg, dataset=dataset)
+
+
 ATTACKS.register("backdoor", _make_backdoor)
+ATTACKS.register("backdoor_timed", _make_backdoor_timed)
 
 from attacking_federate_learning_tpu.attacks.baselines import (  # noqa: E402
     GaussianNoiseAttack, SignFlipAttack

@@ -15,7 +15,9 @@ from statistics import NormalDist
 
 import jax.numpy as jnp
 
-from attacking_federate_learning_tpu.attacks.base import Attack, cohort_stats
+from attacking_federate_learning_tpu.attacks.base import (
+    Attack, delivered_cohort_stats
+)
 
 
 def paper_z(users_count: int, corrupted_count: int) -> float:
@@ -49,7 +51,12 @@ class DriftAttack(Attack):
     name = "alie"
 
     def craft(self, mal_grads, ctx=None):
-        mean, stdev = cohort_stats(mal_grads)
+        # Async rounds (ctx.staleness set): the statistics come from
+        # the DELIVERED malicious rows only — the colluders coordinate
+        # at the aggregation boundary and hide inside the envelope the
+        # server actually aggregates (base.py:delivered_cohort_stats);
+        # synchronous topologies keep the reference full-cohort stats.
+        mean, stdev = delivered_cohort_stats(mal_grads, ctx)
         return mean - self.num_std * stdev
 
     def envelope_stats(self, users_grads, corrupted_count, ctx=None):
@@ -60,7 +67,7 @@ class DriftAttack(Attack):
         f = corrupted_count
         if f == 0 or self.num_std == 0:
             return {}
-        mean, stdev = cohort_stats(users_grads[:f])
+        mean, stdev = delivered_cohort_stats(users_grads[:f], ctx)
         sigma_norm = jnp.linalg.norm(stdev)
         return {"z": jnp.asarray(self.num_std, jnp.float32),
                 "mean_norm": jnp.linalg.norm(mean),

@@ -36,7 +36,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from attacking_federate_learning_tpu.attacks.base import Attack, cohort_stats
+from attacking_federate_learning_tpu.attacks.base import (
+    Attack, cohort_stats, masked_cohort_stats
+)
 from attacking_federate_learning_tpu.core.evaluate import (
     masked_nll_metrics, pad_to_batches
 )
@@ -158,8 +160,17 @@ class BackdoorAttack(Attack):
             return jax.lax.cond(poison_accuracy(start_flat) >= 100.0,
                                 lambda w: w, do_train, start_flat)
 
-        def craft(mal_grads, original_params, learning_rate):
-            mean, stdev = cohort_stats(mal_grads)
+        def craft(mal_grads, original_params, learning_rate,
+                  delivered=None):
+            # ``delivered`` (async rounds, core/async_rounds.py): the
+            # clip envelope and the descent projection come from the
+            # DELIVERED malicious rows only — the server never
+            # aggregates the rest, so laundering against the full
+            # cohort would clip into an envelope nobody measures.
+            if delivered is None:
+                mean, stdev = cohort_stats(mal_grads)
+            else:
+                mean, stdev = masked_cohort_stats(mal_grads, delivered)
             start = original_params - learning_rate * mean
             mal_params = train_shadow(start)
             new_params = mal_params + learning_rate * mean
@@ -173,7 +184,13 @@ class BackdoorAttack(Attack):
 
     # ------------------------------------------------------------------
     def craft(self, mal_grads, ctx):
-        out = self._craft(mal_grads, ctx.original_params, ctx.learning_rate)
+        if ctx is not None and ctx.staleness is not None:
+            f = mal_grads.shape[0]
+            out = self._craft(mal_grads, ctx.original_params,
+                              ctx.learning_rate, ctx.staleness[:f] >= 0)
+        else:
+            out = self._craft(mal_grads, ctx.original_params,
+                              ctx.learning_rate)
         if not isinstance(out, jax.core.Tracer):
             # Staged/eager path: the reference's per-round host nan guard
             # (backdoor.py:145-152).  Inside a fused round program the
@@ -192,7 +209,11 @@ class BackdoorAttack(Attack):
         f = corrupted_count
         if f == 0 or self.num_std == 0:
             return {}
-        _, stdev = cohort_stats(users_grads[:f])
+        if ctx is not None and ctx.staleness is not None:
+            _, stdev = masked_cohort_stats(users_grads[:f],
+                                           ctx.staleness[:f] >= 0)
+        else:
+            _, stdev = cohort_stats(users_grads[:f])
         loss, correct = self._poison_metrics(ctx.original_params)
         return {"z": jnp.asarray(self.num_std, jnp.float32),
                 "clip_halfwidth_norm": jnp.asarray(
@@ -212,3 +233,22 @@ class BackdoorAttack(Attack):
                 "Accuracy: {}/{} ({:.2f}%)".format(
                     tag, float(loss), int(correct), self.poison_count, acc))
         return acc
+
+
+class TimedBackdoorAttack(BackdoorAttack):
+    """The async timing-channel backdoor (ISSUE 9): identical crafting
+    pipeline, but the attacker GAMES THE ARRIVAL SCHEDULE — its rows
+    always emit with delay 0 (``timed``, read by
+    core/async_rounds.py:draw_delays), so every delivered malicious row
+    is fresh: full staleness weight, and a clip envelope computed
+    against whatever stale honest rows share its bus.  The price is
+    FIFO priority — freshest-born rows board the k-bus last — so the
+    timing channel is a measured trade, not a free win (GRID_RESULTS
+    round-9).  The attacker controls content and emission time only;
+    arrival timestamps (hence weights) are the server's.
+
+    Only meaningful under ``aggregation='async'`` — the engine and CLI
+    reject it elsewhere (there is no arrival time to game)."""
+
+    name = "backdoor_timed"
+    timed = True

@@ -29,6 +29,15 @@ class AttackContext(NamedTuple):
     original_params: jax.Array   # (d,) weights broadcast this round
     learning_rate: jax.Array     # faded lr (reference server.py:50-52)
     round: jax.Array = 0         # () int32 round index (rng derivation)
+    # Asynchronous rounds only (core/async_rounds.py): the (m,) int32
+    # per-row staleness view of the DELIVERED cohort — t - birth on
+    # delivered rows, -1 on undelivered ones.  None under the
+    # synchronous topologies, where every row is fresh by construction.
+    # The attack seam runs at DELIVERY time in async mode, so crafting
+    # statistics must come from the delivered sub-cohort
+    # (:func:`delivered_cohort_stats`) — the aggregation never sees the
+    # rest.
+    staleness: Optional[jax.Array] = None
 
 
 def cohort_stats(mal_grads):
@@ -37,6 +46,34 @@ def cohort_stats(mal_grads):
     mean = jnp.mean(mal_grads, axis=0)
     stdev = jnp.sqrt(jnp.var(mal_grads, axis=0))
     return mean, stdev
+
+
+def masked_cohort_stats(mal_grads, delivered):
+    """Mean and population std over the DELIVERED malicious rows only
+    (``delivered`` (f,) bool) — fixed shapes, traced delivered count.
+    With every row delivered this computes exactly
+    :func:`cohort_stats` up to summation order (mean-of-all vs
+    sum/count are the same reduction here: sum over the full axis
+    divided by the full count)."""
+    e = jnp.maximum(jnp.sum(delivered), 1)
+    mean = jnp.sum(jnp.where(delivered[:, None], mal_grads, 0.0),
+                   axis=0) / e
+    var = jnp.sum(jnp.where(delivered[:, None],
+                            (mal_grads - mean[None, :]) ** 2, 0.0),
+                  axis=0) / e
+    return mean, jnp.sqrt(var)
+
+
+def delivered_cohort_stats(mal_grads, ctx):
+    """The crafting statistics an attack seam should use: the classic
+    full-cohort stats under the synchronous topologies, the
+    delivered-sub-cohort stats in async mode (``ctx.staleness >= 0``
+    marks delivery) — how ALIE "recalibrates its envelope to the
+    delivered cohort" (ISSUE 9)."""
+    if ctx is None or ctx.staleness is None:
+        return cohort_stats(mal_grads)
+    f = mal_grads.shape[0]
+    return masked_cohort_stats(mal_grads, ctx.staleness[:f] >= 0)
 
 
 class Attack:

@@ -42,11 +42,15 @@ def build_parser() -> argparse.ArgumentParser:
                             "FLTrust", "Median", "GeoMedian", "NormBound",
                             "DnC", "CenteredClip"])
     p.add_argument("--attack", default="auto",
-                   choices=["auto", "none", "alie", "backdoor", "signflip",
-                            "noise", "minmax", "minsum"],
+                   choices=["auto", "none", "alie", "backdoor",
+                            "backdoor_timed", "signflip", "noise",
+                            "minmax", "minsum"],
                    help="'auto' = reference behavior (backdoor if -b set, "
                         "else ALIE, reference main.py:44-54); the rest are "
-                        "beyond-reference baselines (attacks/)")
+                        "beyond-reference baselines (attacks/); "
+                        "'backdoor_timed' is the async timing-channel "
+                        "variant (emits with delay 0 so its rows always "
+                        "arrive fresh; needs --aggregation async)")
     p.add_argument("--attack-direction", default="std",
                    choices=["std", "sign", "unit"],
                    help="min-max/min-sum perturbation direction "
@@ -195,13 +199,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "CPU-backend 10k opt-in; same standard as "
                         "--trimmed-mean-impl)")
     p.add_argument("--aggregation", default="flat",
-                   choices=["flat", "hierarchical"],
+                   choices=["flat", "hierarchical", "async"],
                    help="'flat' = reference path (one (n, d) matrix, one "
                         "defense call); 'hierarchical' streams the client "
                         "axis through --megabatch-sized scan shards with "
                         "per-shard tier-1 robust estimates and a tier-2 "
                         "cross-shard reduction — the (n, d)/(n, n) arrays "
-                        "never materialize (ops/federated.py)")
+                        "never materialize (ops/federated.py); 'async' = "
+                        "FedBuff-style buffered rounds — updates arrive "
+                        "PRNG-drawn rounds late, the server aggregates "
+                        "the first --async-buffer pending arrivals with "
+                        "staleness-weighted contributions "
+                        "(core/async_rounds.py)")
+    p.add_argument("--async-buffer", default=0, type=int, metavar="K",
+                   help="async mode's FedBuff buffer size: pending "
+                        "updates consumed per round, FIFO (required "
+                        ">= 1 under --aggregation async)")
+    p.add_argument("--async-max-staleness",
+                   default=ExperimentConfig.async_max_staleness,
+                   type=int, metavar="S",
+                   help="async staleness bound: arrival delays draw "
+                        "from [0, S], a pending update older than S "
+                        "rounds is evicted (masked, never aggregated)")
+    p.add_argument("--staleness-weight", default="none",
+                   choices=["none", "poly", "const"],
+                   help="async contribution discount by staleness s: "
+                        "'none' (pure first-k), 'poly' (1/sqrt(1+s), "
+                        "the FedBuff paper), 'const' (0.5 for any "
+                        "stale row) — threaded into the mask-aware "
+                        "kernels' weights= seam")
     p.add_argument("--megabatch", default=0, type=int, metavar="M",
                    help="hierarchical tier-1 shard size m (must divide "
                         "--users-count, >= 2 shards); round peak memory "
@@ -428,6 +454,9 @@ def config_from_args(args) -> ExperimentConfig:
         mal_placement=args.mal_placement,
         tier1_corrupted=args.tier1_corrupted,
         tier2_corrupted=args.tier2_corrupted,
+        async_buffer=args.async_buffer,
+        async_max_staleness=args.async_max_staleness,
+        staleness_weight=args.staleness_weight,
     )
 
 
@@ -473,11 +502,17 @@ def main(argv=None):
         return runs_main(argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
-    if args.attack == "backdoor" and args.backdoor == "No":
+    if (args.attack in ("backdoor", "backdoor_timed")
+            and args.backdoor == "No"):
         # BackdoorAttack's poison set is derived from the -b trigger; an
         # explicit --attack backdoor without one would build an empty set.
-        parser.error("--attack backdoor requires a trigger: "
-                     "-b pattern|1|2|3")
+        parser.error(f"--attack {args.attack} requires a trigger: "
+                     f"-b pattern|1|2|3")
+    if args.attack == "backdoor_timed" and args.aggregation != "async":
+        # The timing channel only exists where arrival time matters.
+        parser.error("--attack backdoor_timed games the async arrival "
+                     "schedule (delay-0 emission); it requires "
+                     "--aggregation async")
     apply_backend(args.backend)
     cfg = config_from_args(args)
 

@@ -158,7 +158,7 @@ class ExperimentConfig:
     # ARCHITECTURE.md "Hierarchical aggregation").  The flat path's
     # compiled HLO is byte-identical with these knobs at any value
     # (tests/test_hierarchy.py pins it).
-    aggregation: str = "flat"        # 'flat' | 'hierarchical'
+    aggregation: str = "flat"        # 'flat' | 'hierarchical' | 'async'
     # Megabatch (tier-1 shard) size m; must divide users_count with at
     # least 2 shards.  Peak round memory scales with m·d, not n·d.
     megabatch: int = 0
@@ -177,6 +177,28 @@ class ExperimentConfig:
     # 4f+3 validity satisfiable at small shard counts).
     tier1_corrupted: Optional[int] = None
     tier2_corrupted: Optional[int] = None
+
+    # --- asynchronous buffered rounds (core/async_rounds.py) ------------
+    # 'async' is the third engine topology: every client still computes
+    # a fresh update each round, but it ARRIVES a PRNG-drawn number of
+    # rounds later; the server consumes the first `async_buffer`
+    # pending arrivals per round FIFO (FedBuff-style), weighting each
+    # delivered row's contribution by its staleness through the
+    # mask-aware kernels' `weights=` seam.  All three knobs are inert
+    # (ignored, like `megabatch` under flat) unless
+    # aggregation='async'; the flat/hierarchical HLO is byte-identical
+    # at any value (tests/test_async.py pins it).
+    # k: pending updates aggregated per round (FIFO; required >= 1
+    # under aggregation='async').
+    async_buffer: int = 0
+    # Eviction bound: a pending update older than this many rounds is
+    # discarded (masked), never aggregated; arrival delays draw
+    # uniformly from [0, max_staleness] (ring depth = max_staleness+1).
+    async_max_staleness: int = 2
+    # Contribution discount by staleness s (core/async_rounds.py):
+    # 'none' = 1 (pure first-k), 'poly' = 1/sqrt(1+s) (the FedBuff
+    # paper's discount), 'const' = 0.5 for any stale row.
+    staleness_weight: str = "none"
 
     # --- evaluation / io ------------------------------------------------
     test_step: int = 5               # reference main.py:58
@@ -471,10 +493,23 @@ class ExperimentConfig:
             raise ValueError(
                 f"median_impl must be 'xla' or 'host', "
                 f"got {self.median_impl!r}")
-        if self.aggregation not in ("flat", "hierarchical"):
+        if self.aggregation not in ("flat", "hierarchical", "async"):
             raise ValueError(
-                f"aggregation must be 'flat' or 'hierarchical', "
-                f"got {self.aggregation!r}")
+                f"aggregation must be 'flat', 'hierarchical' or "
+                f"'async', got {self.aggregation!r}")
+        if self.staleness_weight not in ("none", "poly", "const"):
+            raise ValueError(
+                f"staleness_weight must be 'none', 'poly' or 'const', "
+                f"got {self.staleness_weight!r}")
+        if self.async_buffer < 0 or self.async_max_staleness < 0:
+            raise ValueError(
+                f"async_buffer/async_max_staleness must be >= 0, got "
+                f"{self.async_buffer}/{self.async_max_staleness}")
+        if self.aggregation == "async" and self.async_buffer < 1:
+            raise ValueError(
+                "--aggregation async needs --async-buffer >= 1 (k, the "
+                "pending updates aggregated per round — FedBuff's "
+                "buffer size; core/async_rounds.py)")
         if self.mal_placement not in ("spread", "concentrated"):
             raise ValueError(
                 f"mal_placement must be 'spread' or 'concentrated', "

@@ -1,0 +1,406 @@
+"""FedBuff-style asynchronous buffered rounds (``aggregation='async'``).
+
+The synchronous cohort — every client's round-t update aggregated at
+round t — is the simulator fiction (ROADMAP item 4); production
+federated serving is asynchronous.  This module gives the engine a
+DETERMINISTIC asynchrony model that runs *inside* the fused round
+program (core/engine.py ``_build_async_round_fns``), FedBuff-flavored
+(Nguyen et al., arXiv 2106.06639; TurboSVM-FL's lazy-client regime and
+CLIP's straggler analysis are the PAPERS.md anchors):
+
+- Every client computes a fresh update every round, but the update
+  ARRIVES ``s`` rounds later, ``s`` drawn per (client, round) from a
+  PRNG keyed on ``(seed, round)`` — the whole arrival schedule is a
+  pure function of the config, identical across runs, across resume
+  boundaries, and under the host-side replay (:func:`replay_schedule`)
+  the tests and tools/fault_matrix.py diff emitted events against.
+- In-flight updates ride a fixed-shape ``(D, m, d)`` ring (slot
+  ``t % D`` holds round-t arrivals; ``D = async_max_staleness + 1``)
+  with an occupancy mask and per-entry birth rounds.  A client's newer
+  update landing on a slot that still holds an older in-flight one
+  SUPERSEDES it (the client sends its latest — counted, not hidden).
+- Arrivals merge into a one-slot-per-client PENDING pool (an arrival
+  supersedes the client's older pending update).  The server applies
+  an update only once ``k = async_buffer`` updates are pending —
+  FedBuff's buffer trigger — consuming the FIRST k in FIFO order
+  (oldest birth first, ties to the lowest client id); with fewer than
+  k pending the round is a server no-op and the pool keeps filling.
+  A delivered round therefore aggregates EXACTLY k rows, which is
+  what lets the engine enforce the defense validity bounds at n=k
+  (a Bulyan async round needs k >= 4f+3, exactly like a flat cohort
+  of k).  A pending update whose staleness exceeds
+  ``async_max_staleness`` is EVICTED (over-stale), and non-finite
+  pending rows (fault corruption in flight) are quarantined — both
+  masked, never aggregated.
+- Delivered rows carry their STALENESS ``t - birth`` into (a) the
+  attack seam (``AttackContext.staleness`` — the delivered-cohort view
+  ALIE recalibrates its envelope against, and the channel the timed
+  backdoor games) and (b) the staleness-weight function
+  (``staleness_weight``: 'none' | 'poly' | 'const') whose ``(m,)``
+  weight vector threads into the mask-aware defense kernels
+  (defenses/kernels.py ``weights=`` seam).
+
+Fault composition (core/faults.py): the same ``fault_masks`` schedule
+drives async faults — *dropout* means the update is never submitted
+(no ring write), *straggler* means EXTRA ARRIVAL DELAY
+(``+ straggler_delay``, clipped to the ring depth) instead of the sync
+path's separate stale ring, and *corrupt* damages the submitted row in
+flight (non-finite variants are quarantined at delivery).  The threat
+split survives: corruption stays honest-rows-only, the attack seam
+owns rows [0, f).
+
+Timing-aware attack surface: an attacker with ``timed = True``
+(attacks/backdoor.py TimedBackdoorAttack) controls its own emission
+and always submits with delay 0 — its delivered rows are always fresh
+(full staleness weight, tightest clip envelope), at the price of FIFO
+priority (freshest-born rows board the k-bus last).  The attacker
+controls CONTENT and EMISSION TIME, never the server's arrival
+timestamps: staleness weights cannot be forged.
+
+All shapes are fixed; the whole step is pure jax, so spans scan it and
+the async state (ring + pending, six arrays) checkpoints through the
+Checkpointer ``extra=`` seam exactly like the fault ring buffer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from attacking_federate_learning_tpu.core.faults import fault_masks
+
+
+# Staleness-weight functions w(s) for delivered rows (s >= 0 rounds):
+#   'none'   w = 1           (pure FedBuff first-k, no discount)
+#   'poly'   w = 1/sqrt(1+s) (the FedBuff paper's polynomial discount)
+#   'const'  w = 1 if fresh else 0.5 (a flat stale discount)
+STALENESS_WEIGHTS = ("none", "poly", "const")
+
+# FIFO tie-break sentinel: unoccupied pending slots sort after every
+# real entry.  f32 keys (birth*m + id) stay exact below 2^24 — birth is
+# a round index and m a cohort size, both far under that.
+_EMPTY_KEY = jnp.inf
+
+
+@dataclasses.dataclass(frozen=True)
+class AsyncSpec:
+    """Static facts of one engine's async round (engine _init_async)."""
+
+    buffer: int          # k: pending updates consumed per round (FIFO)
+    max_staleness: int   # eviction bound; ring depth = max_staleness+1
+    weighting: str       # 'none' | 'poly' | 'const'
+    timed: bool = False  # attacker forces its own delay to 0
+
+    @property
+    def depth(self) -> int:
+        return self.max_staleness + 1
+
+
+def async_key(cfg):
+    """The async subsystem's own key stream, derived from (but distinct
+    from) the experiment seed — mirroring core/faults.py:fault_key."""
+    return jax.random.key(cfg.seed ^ 0x0A57C)
+
+
+def init_async_state(spec: AsyncSpec, m: int, d: int):
+    """Fixed-shape device state threaded through the async round
+    program: the in-flight ring (``buf``/``occ``/``birth``, one slot
+    per arrival round) and the server's pending pool
+    (``pbuf``/``pocc``/``pbirth``, one slot per client).  Every array
+    checkpoints through the Checkpointer ``extra=`` seam."""
+    D = spec.depth
+    return {
+        "buf": jnp.zeros((D, m, d), jnp.float32),
+        "occ": jnp.zeros((D, m), bool),
+        "birth": jnp.zeros((D, m), jnp.int32),
+        "pbuf": jnp.zeros((m, d), jnp.float32),
+        "pocc": jnp.zeros((m,), bool),
+        "pbirth": jnp.zeros((m,), jnp.int32),
+    }
+
+
+def draw_delays(key, t, m, m_mal, spec: AsyncSpec, faults=None,
+                fkey=None):
+    """The round-t arrival schedule: ``(delay, drop, corrupt)``.
+
+    ``delay`` (m,) int32 in [0, depth): uniform per (client, round),
+    plus ``straggler_delay`` extra rounds for straggler-fault rows
+    (clipped to the ring depth — a straggler cannot out-wait the
+    buffer), and forced to 0 for the attacker's rows under a timed
+    attack (the attacker controls its own emission).  Pure in
+    ``(key, t)``: runs identically traced and eagerly, which is what
+    :func:`replay_schedule` relies on.  ``drop``/``corrupt`` are the
+    composed fault masks ((m,) bool, all-False without faults) —
+    drawn from ``fkey`` (the fault subsystem's OWN key stream,
+    core/faults.py:fault_key, defaulting to ``key``), so the injected
+    schedule is identical to the sync path's and the host replay
+    tools/fault_matrix.py validates against stays shared.
+    """
+    kt = jax.random.fold_in(key, t)
+    delay = jax.random.randint(kt, (m,), 0, spec.depth)
+    if faults is not None:
+        drop, stale, corrupt = fault_masks(
+            key if fkey is None else fkey, t, m, m_mal, faults)
+        delay = jnp.where(
+            stale,
+            jnp.minimum(delay + faults.straggler_delay, spec.depth - 1),
+            delay)
+    else:
+        drop = corrupt = jnp.zeros((m,), bool)
+    if spec.timed and m_mal > 0:
+        # Static slice: the timed attacker's rows [0, f) always emit
+        # fresh.  Benign faults still apply (dropout is the network's
+        # call, not the attacker's).
+        delay = delay.at[:m_mal].set(0)
+    return delay.astype(jnp.int32), drop, corrupt
+
+
+def staleness_weights(staleness, delivered, weighting: str):
+    """(m,) f32 contribution weights for the delivered rows; zero off
+    the delivered mask (so weighted estimators never read them).
+    ``weighting='none'`` returns None — the kernels' unweighted masked
+    path, byte-identical to the fault-quarantine contract."""
+    if weighting == "none":
+        return None
+    s = jnp.maximum(staleness, 0).astype(jnp.float32)
+    if weighting == "poly":
+        w = 1.0 / jnp.sqrt(1.0 + s)
+    else:  # 'const'
+        w = jnp.where(s > 0, 0.5, 1.0)
+    return jnp.where(delivered, w, 0.0).astype(jnp.float32)
+
+
+def async_step(grads, t, key, spec: AsyncSpec, state, m_mal,
+               faults=None, fkey=None):
+    """One async round against the submitted (m, d) matrix.
+
+    Submits round-t updates into the ring at their drawn arrival slots,
+    takes delivery of slot ``t % D``, merges arrivals into the pending
+    pool, evicts over-stale / quarantines non-finite pending rows, and
+    — once at least ``k`` updates are pending (FedBuff's buffer
+    trigger) — consumes the ``k`` oldest FIFO; below the trigger the
+    round delivers nothing (the engine holds the server state).
+
+    Returns ``(delivered_grads, delivered, staleness, new_state,
+    stats)``:
+
+    - ``delivered_grads`` (m, d): the consumed updates, zero outside
+      the mask (distance engines stay NaN-free, same convention as
+      core/faults.py:quarantine);
+    - ``delivered`` (m,) bool: the aggregation mask;
+    - ``staleness`` (m,) int32: ``t - birth`` on delivered rows, -1
+      elsewhere — the ``AttackContext.staleness`` view;
+    - ``stats``: fixed-shape ``async_*`` scalars/vectors (delivered /
+      pending / in-flight counts, evictions, supersessions, the
+      staleness histogram) that ride the engine's telemetry plumbing
+      into per-round v7 'async' events, plus the ``fault_*`` counts
+      when faults compose.
+    """
+    D, m = spec.depth, grads.shape[0]
+    k = min(spec.buffer, m)
+    delay, drop, corrupt = draw_delays(key, t, m, m_mal, spec, faults,
+                                       fkey)
+
+    submitted = grads.astype(jnp.float32)
+    stats = {}
+    if faults is not None:
+        if faults.corrupt > 0:
+            if faults.corrupt_mode == "scale":
+                submitted = submitted * jnp.where(
+                    corrupt, faults.corrupt_scale, 1.0)[:, None]
+            else:
+                bad = {"nan": jnp.nan, "inf": jnp.inf}[faults.corrupt_mode]
+                submitted = jnp.where(corrupt[:, None],
+                                      jnp.float32(bad), submitted)
+        _, stale_mask, _ = fault_masks(
+            key if fkey is None else fkey, t, m, m_mal, faults)
+        stats.update({
+            "fault_injected_dropout": jnp.sum(drop).astype(jnp.int32),
+            "fault_injected_straggler":
+                jnp.sum(stale_mask).astype(jnp.int32),
+            "fault_injected_corrupt": jnp.sum(corrupt).astype(jnp.int32),
+        })
+
+    # --- submit: row i -> ring slot (t + delay_i) % D ------------------
+    slot_of = jnp.mod(t + delay, D)                      # (m,)
+    write = (slot_of[None, :] == jnp.arange(D)[:, None]) & ~drop[None, :]
+    superseded_inflight = jnp.sum(write & state["occ"]).astype(jnp.int32)
+    buf = jnp.where(write[:, :, None], submitted[None, :, :],
+                    state["buf"])
+    occ = state["occ"] | write
+    birth = jnp.where(write, jnp.asarray(t, jnp.int32), state["birth"])
+
+    # --- deliver slot t % D, then clear it -----------------------------
+    slot = jnp.mod(t, D)
+    arr_occ = lax.dynamic_index_in_dim(occ, slot, 0, keepdims=False)
+    arr_buf = lax.dynamic_index_in_dim(buf, slot, 0, keepdims=False)
+    arr_birth = lax.dynamic_index_in_dim(birth, slot, 0, keepdims=False)
+    occ = lax.dynamic_update_index_in_dim(
+        occ, jnp.zeros((m,), bool), slot, 0)
+
+    # --- merge arrivals into the pending pool (supersede) --------------
+    # Freshness rule: a client's NEWER computation supersedes its
+    # pending older one, but an out-of-order late arrival (lower birth
+    # than the pending entry) is discarded — a fresher pending update
+    # must never be overwritten by a staler one.  Both directions
+    # count as 'superseded' (one of the two updates was displaced).
+    take = arr_occ & (~state["pocc"] | (arr_birth >= state["pbirth"]))
+    superseded_pending = jnp.sum(arr_occ & state["pocc"]).astype(jnp.int32)
+    pbuf = jnp.where(take[:, None], arr_buf, state["pbuf"])
+    pbirth = jnp.where(take, arr_birth, state["pbirth"])
+    pocc = state["pocc"] | arr_occ
+
+    # --- age, evict over-stale, quarantine non-finite ------------------
+    stal = jnp.asarray(t, jnp.int32) - pbirth            # (m,)
+    over = pocc & (stal > spec.max_staleness)
+    evicted = jnp.sum(over).astype(jnp.int32)
+    pocc = pocc & ~over
+    finite = jnp.isfinite(pbuf).all(axis=1)
+    quarantined = jnp.sum(pocc & ~finite).astype(jnp.int32)
+    pocc = pocc & finite
+
+    # --- FedBuff trigger: consume the k oldest pending (FIFO) only
+    # once k are available; otherwise hold (server no-op round) -------
+    order_key = jnp.where(pocc, pbirth.astype(jnp.float32) * m
+                          + jnp.arange(m, dtype=jnp.float32), _EMPTY_KEY)
+    neg, idxs = lax.top_k(-order_key, k)
+    live = jnp.isfinite(neg) & (jnp.sum(pocc) >= k)
+    delivered = jnp.zeros((m,), bool).at[idxs].set(live)
+    delivered_grads = jnp.where(delivered[:, None], pbuf, 0.0)
+    staleness = jnp.where(delivered, stal, -1).astype(jnp.int32)
+    pocc_after = pocc & ~delivered
+
+    new_state = {"buf": buf, "occ": occ, "birth": birth,
+                 "pbuf": pbuf, "pocc": pocc_after, "pbirth": pbirth}
+
+    # Staleness histogram over the delivered rows: fixed (D,) shape.
+    hist = jnp.sum(
+        (staleness[None, :] == jnp.arange(D)[:, None]) & delivered[None, :],
+        axis=1).astype(jnp.int32)
+    stats.update({
+        "async_delivered": jnp.sum(delivered).astype(jnp.int32),
+        "async_pending": jnp.sum(pocc_after).astype(jnp.int32),
+        "async_in_flight": jnp.sum(occ).astype(jnp.int32),
+        "async_evicted": evicted,
+        "async_quarantined": quarantined,
+        "async_superseded": superseded_inflight + superseded_pending,
+        "async_staleness_hist": hist,
+    })
+    return delivered_grads, delivered, staleness, new_state, stats
+
+
+def replay_schedule(cfg, m, m_mal, epochs, timed=False):
+    """Host-side replay of the async delivery dynamics — NO gradients,
+    just the occupancy/ordering machinery (the content-free projection
+    of :func:`async_step`), recomputed with plain numpy from the same
+    PRNG draws.  Returns one dict per round with the counts a v7
+    'async' event must carry; tools/fault_matrix.py's async leg and
+    tests/test_async.py diff emitted events against this.
+    """
+    spec = AsyncSpec(buffer=cfg.async_buffer,
+                     max_staleness=cfg.async_max_staleness,
+                     weighting=cfg.staleness_weight, timed=timed)
+    key = async_key(cfg)
+    D = spec.depth
+    k = min(spec.buffer, m)
+    faults = cfg.faults if (cfg.faults is not None
+                            and cfg.faults.enabled) else None
+    fkey = None
+    if faults is not None:
+        from attacking_federate_learning_tpu.core.faults import fault_key
+        fkey = fault_key(cfg)
+    occ = np.zeros((D, m), bool)
+    birth = np.zeros((D, m), np.int64)
+    pocc = np.zeros((m,), bool)
+    pbirth = np.zeros((m,), np.int64)
+    rows = []
+    for t in range(epochs):
+        delay, drop, _ = (np.asarray(x) for x in
+                          draw_delays(key, t, m, m_mal, spec, faults,
+                                      fkey))
+        slots = (t + delay) % D
+        superseded = int(occ[slots, np.arange(m)][~drop].sum())
+        write = ~drop
+        occ[slots[write], np.arange(m)[write]] = True
+        birth[slots[write], np.arange(m)[write]] = t
+        slot = t % D
+        arr = occ[slot].copy()
+        occ[slot] = False
+        superseded += int((arr & pocc).sum())
+        take = arr & (~pocc | (birth[slot] >= pbirth))
+        pbirth = np.where(take, birth[slot], pbirth)
+        pocc = pocc | arr
+        stal = t - pbirth
+        over = pocc & (stal > spec.max_staleness)
+        evicted = int(over.sum())
+        pocc = pocc & ~over
+        order_key = np.where(pocc, pbirth * m + np.arange(m), np.inf)
+        idxs = np.argsort(order_key, kind="stable")[:k]
+        live = np.isfinite(order_key[idxs]) & (int(pocc.sum()) >= k)
+        delivered = np.zeros((m,), bool)
+        delivered[idxs[live]] = True
+        hist = np.zeros((D,), np.int64)
+        for s in stal[delivered]:
+            if 0 <= s < D:
+                hist[s] += 1
+        pocc = pocc & ~delivered
+        rows.append({
+            "delivered": int(delivered.sum()),
+            "pending": int(pocc.sum()),
+            "in_flight": int(occ.sum()),
+            "evicted": evicted,
+            "superseded": superseded,
+            "staleness_hist": hist.tolist(),
+            "delivered_mask": delivered,
+            "staleness": np.where(delivered, stal, -1),
+        })
+    return rows
+
+
+def check_async_support(cfg):
+    """Fail fast on configs the async round cannot honor (engine init)
+    — the loud-rejection contract of the hierarchical/secagg modes,
+    message text pinned by tests/test_async.py."""
+    from attacking_federate_learning_tpu.core.faults import (
+        MASK_AWARE_DEFENSES
+    )
+
+    if cfg.defense not in MASK_AWARE_DEFENSES:
+        raise ValueError(
+            f"--aggregation async needs a mask-aware defense "
+            f"{MASK_AWARE_DEFENSES}, got {cfg.defense!r} (the delivered-"
+            f"cohort mask and staleness weights must reach the kernel; "
+            f"defenses/kernels.py)")
+    if cfg.participation < 1.0:
+        raise ValueError(
+            "--aggregation async requires participation=1.0: the "
+            "in-flight ring and pending pool are indexed by cohort row, "
+            "and under partial participation rows are different clients "
+            "each round")
+    if cfg.data_placement != "device":
+        raise ValueError(
+            "--aggregation async requires data_placement='device': the "
+            "buffered span is one scanned device program (host "
+            "streaming feeds one round per program by design)")
+    if cfg.backdoor and not cfg.backdoor_fused:
+        raise ValueError(
+            "--aggregation async needs the fused backdoor path (drop "
+            "--backdoor-staged): delivery, staleness weighting and the "
+            "attack seam all live inside the fused round program")
+    host_impls = [
+        ("distance_impl", cfg.distance_impl),
+        ("trimmed_mean_impl", cfg.trimmed_mean_impl),
+        ("median_impl", cfg.median_impl),
+        ("bulyan_selection_impl", cfg.bulyan_selection_impl),
+        ("bulyan_trim_impl", cfg.bulyan_trim_impl),
+    ]
+    for name, val in host_impls:
+        if val == "host":
+            raise ValueError(
+                f"--aggregation async is incompatible with "
+                f"{name}='host': the host engines have no mask/weight "
+                f"seam (defenses/host.py)")

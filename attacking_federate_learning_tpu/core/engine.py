@@ -125,13 +125,24 @@ class FederatedExperiment:
             self._secagg_key = secagg_key(cfg)
         else:
             self._secagg = None
-        # The defense only ever sees the round cohort (flat), or one
-        # megabatch / the shard-estimate matrix (hierarchical).
+        # The defense only ever sees the round cohort (flat), one
+        # megabatch / the shard-estimate matrix (hierarchical), or the
+        # delivered sub-cohort (async).
+        self._async = None
         if cfg.aggregation == "hierarchical":
             self._init_hierarchical()
+        elif cfg.aggregation == "async":
+            self._init_async()
         else:
             self._placement = None
             check_defense_args(cfg.defense, self.m, self.m_mal)
+        if (getattr(self.attacker, "timed", False)
+                and cfg.aggregation != "async"):
+            raise ValueError(
+                "a timed attack (attacks/backdoor.py "
+                "TimedBackdoorAttack) games the async arrival schedule; "
+                "it requires aggregation='async' — under synchronous "
+                "topologies there is no arrival time to game")
         # Fault-injection subsystem (core/faults.py): None is the
         # zero-fault reference path — no fault state, no mask threading,
         # the compiled round program is bit-identical to the
@@ -190,7 +201,10 @@ class FederatedExperiment:
         params0 = self.model.init(k_init)
         self.flat = make_flattener(params0)
         self.state = init_server_state(self.flat.ravel(params0))
-        if self.faults is not None:
+        if self.faults is not None and self._async is None:
+            # Async rounds model stragglers as extra arrival delay
+            # inside their own buffers (core/async_rounds.py) — the
+            # sync fault ring never exists there.
             from attacking_federate_learning_tpu.core.faults import (
                 init_fault_state
             )
@@ -198,6 +212,14 @@ class FederatedExperiment:
                                                  self.flat.dim)
         else:
             self._fault_state = None
+        if self._async is not None:
+            from attacking_federate_learning_tpu.core.async_rounds import (
+                init_async_state
+            )
+            self._async_state = init_async_state(self._async, self.m,
+                                                 self.flat.dim)
+        else:
+            self._async_state = None
 
         shards = make_shards(cfg.partition, self.dataset.train_y, self.n,
                              cfg.seed, cfg.dirichlet_alpha)
@@ -359,6 +381,65 @@ class FederatedExperiment:
         check_tier2_args(cfg.defense, cfg.megabatch, self._tier1_f)
         check_tier2_args(self._tier2_name, S, self._tier2_f)
         self._tier2_fn = TIER2_DEFENSES[self._tier2_name]
+
+    # ------------------------------------------------------------------
+    def _init_async(self):
+        """Validate + plan the FedBuff-style buffered round (ISSUE 9 /
+        ROADMAP item 4; core/async_rounds.py, ARCHITECTURE.md
+        "Asynchronous rounds").
+
+        Arrival, buffering and staleness weighting all live inside the
+        fused round program, so everything that needs a host hop per
+        round — or a defense without the mask/weight seam — is
+        rejected here, loudly, rather than failing deep in a trace:
+        staged attacks, host kernels, partial participation (the ring
+        and pending pool are indexed by cohort row), host streaming.
+        secagg ⊕ async is structurally rejected at config time
+        (vanilla requires flat, groupwise requires hierarchical).
+        Faults COMPOSE: dropout = the update is never submitted,
+        straggler = extra arrival delay, corrupt = damage in flight
+        (core/async_rounds.py:draw_delays)."""
+        cfg = self.cfg
+        from attacking_federate_learning_tpu.core.async_rounds import (
+            AsyncSpec, async_key, check_async_support
+        )
+
+        check_async_support(cfg)
+        if not getattr(self.attacker, "fusable", True):
+            raise ValueError(
+                "--aggregation async needs a fusable attack: delivery, "
+                "staleness weighting and the attack seam live inside "
+                "the fused round program")
+        self._placement = None
+        if cfg.async_buffer > self.m:
+            raise ValueError(
+                f"--async-buffer {cfg.async_buffer} exceeds the cohort "
+                f"(m={self.m}): the FedBuff trigger would never fire — "
+                f"the pending pool holds at most one update per client")
+        # A delivered async round aggregates EXACTLY k rows (the
+        # FedBuff trigger), so the defense validity bounds apply at
+        # n=k with the full f colluders assumed delivered — the
+        # worst-case cohort a timed attack can arrange.
+        try:
+            check_defense_args(cfg.defense, cfg.async_buffer, self.m_mal)
+        except ValueError as e:
+            raise ValueError(
+                f"--aggregation async aggregates exactly "
+                f"k=--async-buffer rows per applied round, so the "
+                f"defense bound applies at n=k: {e}") from e
+        if (cfg.defense == "TrimmedMean"
+                and cfg.async_buffer - self.m_mal - 1 < 1):
+            raise ValueError(
+                f"--aggregation async TrimmedMean keeps "
+                f"k - f - 1 rows per applied round; got "
+                f"k={cfg.async_buffer}, f={self.m_mal} — raise "
+                f"--async-buffer")
+        self._async = AsyncSpec(
+            buffer=cfg.async_buffer,
+            max_staleness=cfg.async_max_staleness,
+            weighting=cfg.staleness_weight,
+            timed=bool(getattr(self.attacker, "timed", False)))
+        self._async_key = async_key(cfg)
 
     # ------------------------------------------------------------------
     def _wire_distance_defense(self, fn):
@@ -579,7 +660,7 @@ class FederatedExperiment:
         return grads
 
     def _aggregate_impl(self, state: ServerState, grads, t, agg=None,
-                        telemetry=False, mask=None):
+                        telemetry=False, mask=None, weights=None):
         """``agg`` pre-empts the defense call — the Krum-telemetry round
         computes the selection once and aggregates ``grads[sel]`` rather
         than running the O(n^2 d) distance engine twice.  ``telemetry``
@@ -587,12 +668,16 @@ class FederatedExperiment:
         returns ``(new_state, diag)`` instead of ``new_state``.
         ``mask``: the quarantine effective-cohort mask (core/faults.py),
         threaded into the mask-aware defense kernels; None (the
-        no-fault path) leaves the defense call byte-identical."""
+        no-fault path) leaves the defense call byte-identical.
+        ``weights``: the async staleness weights riding the same seam
+        (core/async_rounds.py; requires ``mask``)."""
         ddiag = {}
         if agg is None:
             kw = {}
             if mask is not None:
                 kw["mask"] = mask
+            if weights is not None:
+                kw["weights"] = weights
             if getattr(self.defense_fn, "needs_round", False):
                 # Round-seeded defenses (DnC's fresh sketches) — the same
                 # attribute seam FLTrust uses for needs_server_grad.
@@ -623,6 +708,8 @@ class FederatedExperiment:
         cfg = self.cfg
         if cfg.aggregation == "hierarchical":
             return self._build_hier_round_fns()
+        if cfg.aggregation == "async":
+            return self._build_async_round_fns()
 
         def ctx_for(state, t):
             return AttackContext(
@@ -1198,6 +1285,169 @@ class FederatedExperiment:
         self._staged = False
 
     # ------------------------------------------------------------------
+    def _build_async_round_fns(self):
+        """FedBuff-style buffered round (cfg.aggregation='async';
+        core/async_rounds.py, ARCHITECTURE.md "Asynchronous rounds").
+
+        The round is the sync compute pipeline plus the asynchrony
+        machinery, all inside one jit: every client computes a FRESH
+        update against the current broadcast weights (exactly the flat
+        path's ``_compute_grads_impl``), the update is submitted into
+        the in-flight ring at its PRNG-drawn arrival slot, round-t
+        arrivals merge into the pending pool, and the server consumes
+        the first ``async_buffer`` pending updates FIFO — delivered
+        rows masked into the mask-aware defense kernels with their
+        staleness weights threaded as a fixed-shape ``(m,)`` vector
+        through the ``weights=`` seam.
+
+        ATTACK-SEAM SEMANTICS CHANGE (documented contract of the
+        flag): ``Attack.craft`` runs at DELIVERY time over the
+        delivered matrix — the colluders coordinate at the aggregation
+        boundary, their crafting statistics come from the DELIVERED
+        malicious sub-cohort (``AttackContext.staleness``,
+        attacks/base.py:delivered_cohort_stats), and a ``timed``
+        attacker additionally forces its own emission delay to 0.  The
+        attacker controls content and emission time; arrival
+        timestamps (hence staleness weights) are the server's.
+
+        A round with NO deliveries is a server no-op: weights and
+        velocity hold (the round counter still advances) — a real
+        async server does nothing until updates arrive.
+
+        Spans always scan (``_async_span``): the stacked per-round
+        pytree carries the ``async_*`` counts (and ``fault_*`` under
+        composed faults) whether or not cfg.telemetry, exactly like
+        the fault span — v7 'async' events are emitted per round.  The
+        async state (ring + pending) rides the carry and checkpoints
+        through the Checkpointer ``extra=`` seam
+        (:meth:`carry_state_host`)."""
+        cfg = self.cfg
+        from attacking_federate_learning_tpu.core.async_rounds import (
+            async_step, staleness_weights
+        )
+        from attacking_federate_learning_tpu.defenses.kernels import (
+            population_telemetry
+        )
+
+        spec = self._async
+        D = spec.depth
+
+        def ctx_for(state, t, staleness=None):
+            return AttackContext(
+                original_params=state.weights,
+                learning_rate=faded_learning_rate(
+                    cfg.learning_rate, cfg.fading_rate, t),
+                round=t, staleness=staleness)
+
+        self._ctx_for = ctx_for
+        # Same predicate as the flat path (the in-program shadow-train
+        # nan guard), evaluated over the crafted delivered rows.
+        self._check_attack_nan = (
+            getattr(self.attacker, "checks_finite", False)
+            and self.m_mal > 0
+            and getattr(self.attacker, "num_std", 1) != 0)
+
+        def crafted_nonfinite(grads):
+            return (~jnp.isfinite(
+                grads[: self.m_mal].astype(jnp.float32))).any()
+
+        def async_core(state, t, astate):
+            grads = self._compute_grads_impl(state, t)
+            (delivered_grads, delivered, staleness, astate,
+             stats) = async_step(
+                grads, t, self._async_key, spec, astate, self.m_mal,
+                faults=self.faults,
+                fkey=self._fault_key if self.faults is not None
+                else None)
+            ctx = ctx_for(state, t, staleness)
+            tele = dict(stats)
+            if cfg.telemetry:
+                env = self.attacker.envelope_stats(delivered_grads,
+                                                   self.m_mal, ctx)
+                tele.update({"attack_" + k: v for k, v in env.items()})
+            # Attack at delivery; undelivered rows [0, f) get
+            # overwritten too, so re-mask before aggregation (the
+            # quarantine zero convention — distance engines NaN-free).
+            crafted = self.attacker.apply(delivered_grads, self.m_mal,
+                                          ctx)
+            bad = (crafted_nonfinite(crafted)
+                   if self._check_attack_nan else jnp.asarray(False))
+            agg_grads = jnp.where(delivered[:, None], crafted, 0.0)
+            weights = staleness_weights(staleness, delivered,
+                                        spec.weighting)
+            # Weight mass by staleness bucket — the science surface
+            # ('async' events; weighting='none' reports unit weights).
+            w_eff = (weights if weights is not None
+                     else jnp.where(delivered, 1.0, 0.0))
+            bucket = staleness[None, :] == jnp.arange(D)[:, None]
+            tele["async_weight_mass"] = jnp.sum(
+                bucket * w_eff[None, :], axis=1).astype(jnp.float32)
+            if cfg.telemetry:
+                upd, ddiag = self._aggregate_impl(
+                    state, agg_grads, t, telemetry=True, mask=delivered,
+                    weights=weights)
+                for dk, dv in ddiag.items():
+                    tele["defense_" + dk] = dv
+                tele.update(population_telemetry(agg_grads))
+            else:
+                upd = self._aggregate_impl(state, agg_grads, t,
+                                           mask=delivered,
+                                           weights=weights)
+            # Empty delivery = server no-op (weights/velocity hold,
+            # the round counter still advances).
+            any_del = jnp.any(delivered)
+            new_state = ServerState(
+                weights=jnp.where(any_del, upd.weights, state.weights),
+                velocity=jnp.where(any_del, upd.velocity,
+                                   state.velocity),
+                round=upd.round)
+            diag = {}
+            if cfg.log_round_stats:
+                # Norm stats over the COMPUTED cohort (what clients
+                # submitted this round — comparable to the flat
+                # fields); the delivered view lives in async_* stats.
+                norms = jnp.linalg.norm(grads.astype(jnp.float32),
+                                        axis=1)
+                diag = {
+                    "grad_norm_mean": jnp.mean(norms),
+                    "grad_norm_max": jnp.max(norms),
+                    "grad_norm_min": jnp.min(norms),
+                    "update_norm": jnp.linalg.norm(new_state.velocity),
+                    "faded_lr": faded_learning_rate(
+                        cfg.learning_rate, cfg.fading_rate, t),
+                }
+            return new_state, diag, bad, tele, astate
+
+        def fused(state, t, astate, batches=None):
+            # `batches` mirrors the flat faulted signature (run_round
+            # always passes it); async is device-resident-only, so it
+            # is always None (validated at init).
+            return async_core(state, t, astate)
+
+        def async_span(state, t0, count, astate):
+            # Always a scan (static count): the stacked per-round
+            # pytree carries the async_* counts with or without
+            # telemetry — 'async' events are per-round, like 'fault'.
+            def body(carry, i):
+                s, bad, a = carry
+                s2, _, b, tele, a = async_core(s, t0 + i, a)
+                if self._check_attack_nan:
+                    bad = bad | b
+                return (s2, bad, a), tele
+
+            (s, bad, a), stacked = jax.lax.scan(
+                body, (state, jnp.asarray(False), astate),
+                jnp.arange(count))
+            return s, bad, a, stacked
+
+        # Like the fault paths, async never donates: the buffer state
+        # rides the carry and the stacked-scan outputs add aliasing
+        # surface beyond what _donate_kw's CPU rationale distrusts.
+        self._fused_round = jax.jit(fused)
+        self._async_span = jax.jit(async_span, static_argnums=2)
+        self._staged = False
+
+    # ------------------------------------------------------------------
     def cost_report(self, logger=None, span: Optional[int] = None):
         """Static compile-and-cost facts for every jitted entry point
         this engine built (utils/costs.py): each is lowered and
@@ -1248,7 +1498,17 @@ class FederatedExperiment:
         round_name, span_name = (("hier_round", "hier_span") if hier
                                  else ("fused_round", "fused_span"))
         if not self._staged:
-            if self.faults is None:
+            if self._async is not None:
+                # Async engines expose their two jitted entry points
+                # under their own ledger names (the buffer state rides
+                # the signatures).
+                entries.append(("async_round", lambda: self._fused_round
+                                .lower(self.state, t0,
+                                       self._async_state, batches)))
+                entries.append(
+                    ("async_span", lambda: self._async_span.lower(
+                        self.state, t0, span_len, self._async_state)))
+            elif self.faults is None:
                 entries.append((round_name, lambda: self._fused_round
                                 .lower(self.state, t0, batches)))
                 if not self._streaming:
@@ -1357,21 +1617,48 @@ class FederatedExperiment:
         memory or the donation clobbers them."""
         return jax.tree.map(lambda a: np.array(a, copy=True), tree)
 
-    def restore_fault_state(self, extra):
-        """Re-install checkpointed fault-injection state (the straggler
-        ring buffer) after a resume (cli.py --resume / Checkpointer
-        ``extra``) so a resumed faulted run continues bit-for-bit."""
-        if self.faults is None or not extra:
-            return
-        if "stale" in extra:
-            self._fault_state = {"stale": jnp.asarray(extra["stale"])}
-
-    def fault_state_host(self):
-        """Host copy of the fault state for checkpointing (None when
-        faults are off or the state is empty)."""
+    def carry_state_host(self):
+        """Host copy of the engine's cross-round carry state for the
+        Checkpointer ``extra=`` seam: the async ring + pending pool
+        (six ``async_*``-keyed arrays — f32 buffers, bool occupancy
+        masks, int32 birth counters) under aggregation='async', or the
+        straggler ring buffer (``stale``) under sync fault injection.
+        None when the engine carries nothing beyond the ServerState."""
+        if self._async is not None and self._async_state:
+            host = self._host_copy(self._async_state)
+            return {"async_" + k: v for k, v in host.items()}
         if self.faults is None or not self._fault_state:
             return None
         return self._host_copy(self._fault_state)
+
+    def restore_carry_state(self, extra):
+        """Re-install checkpointed carry state (the fault ring buffer
+        or the async buffers) after a resume (cli.py --resume /
+        Checkpointer ``extra``) so a resumed run continues
+        bit-for-bit.  Dtypes are restored per array (npz round-trips
+        bool occupancy and int32 birth counters faithfully, but a
+        foreign writer may widen — coerce to the engine's layout)."""
+        if not extra:
+            return
+        if self._async is not None:
+            if any(k.startswith("async_") for k in extra):
+                ref = self._async_state
+                self._async_state = {
+                    k: jnp.asarray(extra["async_" + k]).astype(v.dtype)
+                    for k, v in ref.items()}
+            return
+        if self.faults is not None and "stale" in extra:
+            self._fault_state = {"stale": jnp.asarray(extra["stale"])}
+
+    def restore_fault_state(self, extra):
+        """Back-compat alias (pre-async spelling; cli.py --resume and
+        older callers)."""
+        self.restore_carry_state(extra)
+
+    def fault_state_host(self):
+        """Back-compat alias for :meth:`carry_state_host` (pre-async
+        spelling — it now also returns the async buffers)."""
+        return self.carry_state_host()
 
     def _diverged(self) -> bool:
         """Divergence watchdog predicate, evaluated at span boundaries
@@ -1405,7 +1692,9 @@ class FederatedExperiment:
                       if self.shardings is not None
                       else jax.tree.map(jnp.asarray, st))
         if fs is not None:
-            self._fault_state = jax.tree.map(jnp.asarray, fs)
+            # fs is the carry_state_host() form (async_* keys or the
+            # fault ring), so the restore path is shared with --resume.
+            self.restore_carry_state(fs)
         if checkpointer is not None:
             # On-failure checkpoint: persist the state we rolled back
             # to, so an external --resume lands on the same round.
@@ -1444,7 +1733,7 @@ class FederatedExperiment:
         else:
             self.last_round_stats = None
             self.last_span_telemetry = None
-            pre_span = pre_fstate = None
+            pre_span = pre_fstate = pre_astate = None
             if self._check_attack_nan:
                 # The span donates self.state, so when the in-program nan
                 # flag fires the post-nan state is all a caller would have
@@ -1456,9 +1745,21 @@ class FederatedExperiment:
                 # zero-copy view of the very buffer the span donates,
                 # and a clobbered snapshot restores garbage.
                 pre_span = self._host_copy(self.state)
-                if self.faults is not None:
+                if self._fault_state is not None:
                     pre_fstate = self._host_copy(self._fault_state)
-            if self.faults is not None:
+                if self._async_state is not None:
+                    pre_astate = self._host_copy(self._async_state)
+            if self._async is not None:
+                # Async spans always scan: the stacked per-round pytree
+                # carries the 'async_*' counts (v7 'async' events are
+                # per-round, telemetry on or off) and the buffer state
+                # rides the carry.
+                (self.state, bad, self._async_state, stacked) = (
+                    self._async_span(self.state,
+                                     jnp.asarray(start, jnp.int32),
+                                     int(count), self._async_state))
+                self.last_span_telemetry = (int(start), stacked)
+            elif self.faults is not None:
                 # Fault spans always scan (the stacked per-round pytree
                 # carries the 'fault_*' counts even without telemetry).
                 self.state, bad, self._fault_state, stacked = (
@@ -1485,6 +1786,9 @@ class FederatedExperiment:
                 if pre_fstate is not None:
                     self._fault_state = jax.tree.map(jnp.asarray,
                                                      pre_fstate)
+                if pre_astate is not None:
+                    self._async_state = jax.tree.map(jnp.asarray,
+                                                     pre_astate)
                 self._raise_if_attack_nan(bad)
         return self.state
 
@@ -1494,7 +1798,11 @@ class FederatedExperiment:
         self.last_round_stats = None
         self.last_round_telemetry = None
         if not self._staged:
-            if self.faults is not None:
+            if self._async is not None:
+                (self.state, diag, bad, tele,
+                 self._async_state) = self._fused_round(
+                    self.state, t, self._async_state, batches)
+            elif self.faults is not None:
                 (self.state, diag, bad, tele,
                  self._fault_state) = self._fused_round(
                     self.state, t, self._fault_state, batches)
@@ -1577,10 +1885,17 @@ class FederatedExperiment:
         end-of-run selection histogram."""
         defense_fields, attack_fields = {}, {}
         fault_fields, secagg_fields, shard_fields = {}, {}, {}
+        async_fields = {}
         for k, v in tele.items():
             val = _jsonable(v)
             if k.startswith("attack_"):
                 attack_fields[k[len("attack_"):]] = val
+            elif k.startswith("async_"):
+                # v7 'async' record: scalar counts land as ints, the
+                # staleness histogram / weight-mass vectors as lists.
+                async_fields[k[len("async_"):]] = (
+                    int(val) if isinstance(val, float)
+                    and float(val).is_integer() else val)
             elif k.startswith("fault_"):
                 fault_fields[k[len("fault_"):]] = int(val)
             elif k.startswith("secagg_"):
@@ -1601,6 +1916,8 @@ class FederatedExperiment:
                 defense_fields[k] = val  # population stats
         if fault_fields:
             logger.record(kind="fault", round=int(t), **fault_fields)
+        if async_fields:
+            logger.record(kind="async", round=int(t), **async_fields)
         if secagg_fields:
             logger.record(kind="secagg", round=int(t), **secagg_fields)
         if not self.cfg.telemetry:
@@ -1789,7 +2106,8 @@ class FederatedExperiment:
                                    * ckpt_every)
                 self.run_span(epoch, boundary - epoch + 1)
                 if ((cfg.telemetry or self.faults is not None
-                        or self._secagg is not None)
+                        or self._secagg is not None
+                        or self._async is not None)
                         and self.last_span_telemetry is not None):
                     # ONE host fetch per eval interval: the whole stacked
                     # telemetry pytree comes over at the eval boundary.
@@ -1813,7 +2131,8 @@ class FederatedExperiment:
                                   **{k: float(v) for k, v in
                                      self.last_round_stats.items()})
                 if ((cfg.telemetry or self.faults is not None
-                        or self._secagg is not None)
+                        or self._secagg is not None
+                        or self._async is not None)
                         and fresh(epoch)
                         and self.last_round_telemetry is not None):
                     self._emit_round_telemetry(
@@ -1846,7 +2165,13 @@ class FederatedExperiment:
                                               test_size)
                 if (accuracy > cfg.checkpoint_acc_threshold
                         and checkpointer is not None):
-                    checkpointer.save(self.state, accuracy)
+                    # Carry state rides EVERY checkpoint (not just the
+                    # autos): --resume picks the newest by round, and a
+                    # best-accuracy save that tied an auto would
+                    # otherwise silently drop the async buffers / fault
+                    # ring on resume.
+                    checkpointer.save(self.state, accuracy,
+                                      extra=self.carry_state_host())
                 if cfg.backdoor and hasattr(self.attacker, "test_asr"):
                     # Post-aggregation backdoor check, printed after the
                     # accuracy line as in the reference (main.py:91-95).

@@ -58,7 +58,11 @@ def check_fault_support(cfg):
         raise ValueError(
             "straggler faults need participation=1.0: the stale ring "
             "buffer is indexed by cohort row, and under partial "
-            "participation rows are different clients each round")
+            "participation rows are different clients each round; for "
+            "a straggler regime the server is designed around, use "
+            "--aggregation async instead — there straggler faults "
+            "become extra arrival delay in the buffered round "
+            "(core/async_rounds.py)")
     host_impls = [
         ("distance_impl", cfg.distance_impl),
         ("trimmed_mean_impl", cfg.trimmed_mean_impl),

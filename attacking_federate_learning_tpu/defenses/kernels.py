@@ -130,7 +130,7 @@ def _host_defense(host_fn, users_grads, users_count, corrupted_count,
                              users_grads.astype(jnp.float32))
 
 
-def masked_median(users_grads, mask):
+def masked_median(users_grads, mask, weights=None):
     """Median along the client axis over the alive rows only.
 
     The alive count is data-dependent (traced), but shapes stay fixed:
@@ -138,16 +138,36 @@ def masked_median(users_grads, mask):
     the middle one/two of the first ``e`` sorted entries with dynamic
     indices.  With an all-true mask this computes exactly
     ``jnp.median`` (same sort, same mean-of-two-middles).
+
+    ``weights`` (the staleness seam, core/async_rounds.py): the
+    WEIGHTED lower median — per coordinate, the smallest alive value
+    whose cumulative weight reaches half the total weight mass.  With
+    equal weights this is the classical lower median (NOT the
+    mean-of-two-middles at even counts — the one documented deviation
+    of the weighted path; it only runs under
+    ``staleness_weight != 'none'``).
     """
     vals = jnp.where(mask[:, None], users_grads, _INF)
     srt = jnp.sort(vals, axis=0)
+    if weights is not None:
+        order = jnp.argsort(vals, axis=0)
+        w = jnp.where(mask, weights, 0.0)
+        w_srt = jnp.take_along_axis(
+            jnp.broadcast_to(w[:, None], vals.shape), order, axis=0)
+        cum = jnp.cumsum(w_srt, axis=0)
+        half = jnp.sum(w) / 2.0
+        # First sorted row whose cumulative weight reaches half; +inf
+        # sentinels carry zero weight so the pick stays alive.
+        pick = jnp.argmax(cum >= half, axis=0)
+        return jnp.take_along_axis(srt, pick[None, :], axis=0)[0]
     e = jnp.sum(mask).astype(jnp.int32)
     lo = jnp.take(srt, (e - 1) // 2, axis=0)
     hi = jnp.take(srt, e // 2, axis=0)
     return (lo + hi) / 2
 
 
-def masked_trimmed_mean_of(users_grads, mask, number_to_consider):
+def masked_trimmed_mean_of(users_grads, mask, number_to_consider,
+                           weights=None):
     """Mask-aware median-anchored trimmed mean (the quarantine seam).
 
     Same estimator as :func:`trimmed_mean_of` over the alive rows only:
@@ -156,6 +176,14 @@ def masked_trimmed_mean_of(users_grads, mask, number_to_consider):
     (e - f - 1 with e the data-dependent alive count).  Fixed shapes
     throughout; the keep boundary is a rank comparison instead of a
     static slice.
+
+    ``weights`` (the staleness seam, core/async_rounds.py): the TRIM
+    stays rank-based and unweighted (robustness semantics — which
+    values survive is a question of magnitude, not recency), but the
+    kept deviations average with per-row weights, so a stale row's
+    surviving coordinates contribute proportionally less.  The median
+    anchor stays unweighted.  ``weights=None`` is byte-identical to
+    the pre-seam path.
     """
     n = users_grads.shape[0]
     med = masked_median(users_grads, mask)
@@ -168,6 +196,13 @@ def masked_trimmed_mean_of(users_grads, mask, number_to_consider):
     # watchdog, not a NaN aggregate, is the recovery path.
     k = jnp.maximum(number_to_consider, 1)
     keep = jnp.arange(n)[:, None] < k
+    if weights is not None:
+        w = jnp.where(mask, weights, 0.0)
+        w_s = jnp.take_along_axis(
+            jnp.broadcast_to(w[:, None], sdev.shape), order, axis=0)
+        wk = jnp.where(keep, w_s, 0.0)
+        mass = jnp.maximum(jnp.sum(wk, axis=0), 1e-12)
+        return jnp.sum(wk * sdev, axis=0) / mass + med
     return jnp.sum(jnp.where(keep, sdev, 0.0), axis=0) / k + med
 
 
@@ -185,11 +220,19 @@ def population_telemetry(users_grads):
 
 @DEFENSES.register("NoDefense")
 def no_defense(users_grads, users_count, corrupted_count, telemetry=False,
-               mask=None):
+               mask=None, weights=None):
     """Plain FedAvg mean (reference defences.py:13-14).  ``mask`` (the
     quarantine seam, core/faults.py): mean over the alive rows only —
-    a zeroed dropout row must not drag the average toward zero."""
-    if mask is None:
+    a zeroed dropout row must not drag the average toward zero.
+    ``weights`` (the staleness seam, core/async_rounds.py — requires
+    ``mask``): the weighted alive mean ``sum(w_i g_i)/sum(w_i)`` —
+    FedBuff's staleness-discounted aggregate."""
+    check_weight_seam(mask, weights)
+    if weights is not None:
+        w = jnp.where(mask, weights, 0.0)
+        agg = (w @ users_grads.astype(jnp.float32)) / jnp.maximum(
+            jnp.sum(w), 1e-12)
+    elif mask is None:
         agg = jnp.mean(users_grads, axis=0)
     else:
         e = jnp.maximum(jnp.sum(mask), 1)
@@ -359,7 +402,7 @@ def krum_select(users_grads, users_count, corrupted_count,
 @DEFENSES.register("Krum")
 def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
          method="sort", distance_impl="xla", D=None, distance_dtype=None,
-         telemetry=False, mask=None):
+         telemetry=False, mask=None, weights=None):
     """Krum selection (reference defences.py:23-42): the single gradient
     whose summed distance to its k nearest peers is minimal.
 
@@ -379,23 +422,30 @@ def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
     ``mask`` (the quarantine seam, core/faults.py): quarantined rows
     can never win selection and are excluded from every row's score;
     the winner is the Krum choice of the alive sub-cohort.
+
+    ``weights`` (the staleness seam, core/async_rounds.py — requires
+    ``mask``): selection stays unweighted (distances don't age), but
+    the winning row's contribution is scaled by ITS weight — a stale
+    Krum winner moves the server proportionally less.
     """
     if not telemetry:
-        return users_grads[krum_select(users_grads, users_count,
-                                       corrupted_count,
-                                       paper_scoring=paper_scoring,
-                                       method=method,
-                                       distance_impl=distance_impl, D=D,
-                                       distance_dtype=distance_dtype,
-                                       mask=mask)]
+        idx = krum_select(users_grads, users_count, corrupted_count,
+                          paper_scoring=paper_scoring, method=method,
+                          distance_impl=distance_impl, D=D,
+                          distance_dtype=distance_dtype, mask=mask)
+        if weights is not None:
+            return users_grads[idx] * weights[idx]
+        return users_grads[idx]
     scores, idx = _krum_scores_and_index(
         users_grads, users_count, corrupted_count, paper_scoring, method,
         distance_impl, D, distance_dtype, mask=mask)
     n = users_grads.shape[0]
     scores_out = (jnp.full((n,), jnp.nan, jnp.float32) if scores is None
                   else scores.astype(jnp.float32))
-    mask = jnp.zeros((n,), jnp.float32).at[idx].set(1.0)
-    return users_grads[idx], {"selection_mask": mask, "scores": scores_out}
+    sel = jnp.zeros((n,), jnp.float32).at[idx].set(1.0)
+    agg = (users_grads[idx] * weights[idx] if weights is not None
+           else users_grads[idx])
+    return agg, {"selection_mask": sel, "scores": scores_out}
 
 
 def trimmed_mean_of(users_grads, number_to_consider, impl="xla",
@@ -446,7 +496,7 @@ def trimmed_mean_of(users_grads, number_to_consider, impl="xla",
 
 @DEFENSES.register("TrimmedMean")
 def trimmed_mean(users_grads, users_count, corrupted_count, impl="xla",
-                 telemetry=False, mask=None):
+                 telemetry=False, mask=None, weights=None):
     """Reference defences.py:44-52; keeps n - f - 1 coordinates.
 
     ``impl='host'`` (opt-in, config ``trimmed_mean_impl``) routes to the
@@ -463,7 +513,11 @@ def trimmed_mean(users_grads, users_count, corrupted_count, impl="xla",
     ``mask`` (the quarantine seam, core/faults.py): the estimator runs
     over the alive rows only — alive median anchor, keep count
     e - f - 1 with e the data-dependent alive count (the trim budget
-    shrinks with the cohort, it is not spent on quarantined rows)."""
+    shrinks with the cohort, it is not spent on quarantined rows).
+
+    ``weights`` (the staleness seam, core/async_rounds.py — requires
+    ``mask``): the trim stays rank-based; the kept deviations average
+    weighted (see :func:`masked_trimmed_mean_of`)."""
     if mask is not None:
         if impl == "host":
             raise ValueError(
@@ -472,7 +526,8 @@ def trimmed_mean(users_grads, users_count, corrupted_count, impl="xla",
         n = users_grads.shape[0]
         e = jnp.sum(mask)
         agg = masked_trimmed_mean_of(users_grads, mask,
-                                     e - corrupted_count - 1)
+                                     e - corrupted_count - 1,
+                                     weights=weights)
         if not telemetry:
             return agg
         return agg, {"kept_fraction": jnp.full((n,), jnp.nan, jnp.float32),
@@ -556,7 +611,7 @@ def _bulyan_diag(n, selected, Dm, users_count, corrupted_count,
 def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
            method="sort", distance_impl="xla", D=None, batch_select=1,
            distance_dtype=None, selection_impl="xla", trim_impl="xla",
-           telemetry=False, mask=None):
+           telemetry=False, mask=None, weights=None):
     """Bulyan (reference defences.py:55-70): iteratively Krum-select
     n - 2f gradients (removing each winner from the pool, with the pool
     size — but not f — shrinking), then trim-mean the selection with
@@ -619,7 +674,12 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
     admitted only after every alive row (finite below-+inf sentinel) and
     excluded again from the final trimmed mean by an alive sub-mask —
     so a quarantined row can pad the selection buffer but never touches
-    the aggregate."""
+    the aggregate.
+
+    ``weights`` (the staleness seam, core/async_rounds.py — requires
+    ``mask``): selection stays unweighted; the final masked trimmed
+    mean over the selected rows averages with their per-row weights
+    (:func:`masked_trimmed_mean_of`)."""
     n, _ = users_grads.shape
     f = corrupted_count
     set_size = users_count - 2 * f
@@ -739,8 +799,9 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
         sel_alive = mask[selected]
         e_set = jnp.sum(mask) - 2 * f
         sel_mask = sel_alive & (jnp.cumsum(sel_alive) <= e_set)
-        agg = masked_trimmed_mean_of(selection, sel_mask,
-                                     jnp.sum(sel_mask) - 2 * f - 1)
+        agg = masked_trimmed_mean_of(
+            selection, sel_mask, jnp.sum(sel_mask) - 2 * f - 1,
+            weights=None if weights is None else weights[selected])
         if not telemetry:
             return agg
         dm = jnp.zeros((n,), jnp.float32).at[selected].set(
@@ -811,6 +872,16 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
 # raw material of the colluder-localization forensics (report.py).
 # With it off (the default) the call is byte-for-byte the
 # pre-telemetry path, same as the flat kernels' contract.
+
+def check_weight_seam(mask, weights):
+    """The staleness-weight seam (core/async_rounds.py) rides the
+    quarantine mask: a ``weights=`` without a ``mask=`` has no
+    delivered-cohort to weight and is a caller bug, rejected loudly."""
+    if weights is not None and mask is None:
+        raise ValueError(
+            "defense weights= requires mask= (staleness weights apply "
+            "to the delivered cohort only; core/async_rounds.py)")
+
 
 def _alive_to_mask(alive_counts):
     return None if alive_counts is None else alive_counts > 0

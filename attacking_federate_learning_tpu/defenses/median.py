@@ -15,7 +15,7 @@ from attacking_federate_learning_tpu.defenses.kernels import DEFENSES
 
 @DEFENSES.register("Median")
 def median(users_grads, users_count, corrupted_count, impl="xla",
-           telemetry=False, mask=None):
+           telemetry=False, mask=None, weights=None):
     """``impl='host'`` (opt-in, config ``median_impl``) routes to the
     native column-blocked kernel (native/bulyan_select.cpp:fl_median) —
     same rationale and same non-auto-dispatch rule as
@@ -28,7 +28,15 @@ def median(users_grads, users_count, corrupted_count, impl="xla",
 
     ``mask`` (the quarantine seam, core/faults.py): the median of the
     alive rows only (kernels.py:masked_median — fixed shapes, traced
-    alive count)."""
+    alive count).
+
+    ``weights`` (the staleness seam, core/async_rounds.py — requires
+    ``mask``): the weighted lower median, the value where cumulative
+    weight crosses half the mass (kernels.py:masked_median)."""
+    from attacking_federate_learning_tpu.defenses.kernels import (
+        check_weight_seam
+    )
+    check_weight_seam(mask, weights)
     if mask is not None:
         if impl == "host":
             raise ValueError(
@@ -37,7 +45,7 @@ def median(users_grads, users_count, corrupted_count, impl="xla",
         from attacking_federate_learning_tpu.defenses.kernels import (
             masked_median
         )
-        agg = masked_median(users_grads, mask)
+        agg = masked_median(users_grads, mask, weights=weights)
         if not telemetry:
             return agg
         G = users_grads.astype(jnp.float32)

@@ -11,7 +11,11 @@ study.  This module automates them over the structured event schema
   (Krum one-hot, Bulyan multi-hot) or the end-of-run 'selection_hist';
 - **phase timing** — the PhaseTimer summary from 'profile' events;
 - **trajectories** — accuracy from 'eval' events, attack success from
-  'asr' events.
+  'asr' events;
+- **staleness rollup** — per-round delivered counts, the aggregate
+  staleness histogram and the weight mass per staleness bucket from
+  v7 'async' events (asynchronous buffered rounds,
+  core/async_rounds.py).
 
 Usage (cli.py dispatches the subcommand)::
 
@@ -284,6 +288,56 @@ def fault_recovery(events):
             "quarantined": quarantined, "rollbacks": rollbacks}
 
 
+def async_summary(events):
+    """Staleness rollup from v7 'async' events (core/async_rounds.py):
+    per-round delivered counts, the aggregate staleness histogram, the
+    weight mass by staleness bucket (how much aggregation influence
+    each staleness level actually carried — the staleness-weighting
+    policy's measured effect), buffer occupancy, and the
+    eviction/supersession/quarantine totals.  Returns None when the
+    run emitted no async events (synchronous topologies)."""
+    recs = sorted((e for e in events if e.get("kind") == "async"),
+                  key=lambda e: e.get("round", 0))
+    if not recs:
+        return None
+    hists = [e.get("staleness_hist") for e in recs
+             if isinstance(e.get("staleness_hist"), list)]
+    masses = [e.get("weight_mass") for e in recs
+              if isinstance(e.get("weight_mass"), list)]
+    delivered = [int(e.get("delivered", 0)) for e in recs]
+    out = {
+        "rounds": len(recs),
+        "delivered_per_round": delivered,
+        "delivered_total": sum(delivered),
+        "delivered_mean": round(sum(delivered) / len(recs), 3),
+        "empty_rounds": sum(1 for d in delivered if d == 0),
+        "evicted_total": sum(int(e.get("evicted", 0)) for e in recs),
+        "superseded_total": sum(int(e.get("superseded", 0))
+                                for e in recs),
+        "quarantined_total": sum(int(e.get("quarantined", 0))
+                                 for e in recs),
+        "pending_last": int(recs[-1].get("pending", 0)),
+        "in_flight_mean": round(
+            sum(int(e.get("in_flight", 0)) for e in recs) / len(recs),
+            2),
+    }
+    if hists:
+        depth = max(len(h) for h in hists)
+        agg = [0] * depth
+        for h in hists:
+            for s, v in enumerate(h):
+                agg[s] += int(v)
+        out["staleness_hist"] = agg
+    if masses:
+        depth = max(len(w) for w in masses)
+        agg_w = [0.0] * depth
+        for w in masses:
+            for s, v in enumerate(w):
+                agg_w[s] += float(v)
+        out["weight_mass"] = [round(x, 3) for x in agg_w]
+    return out
+
+
 def secagg_summary(events):
     """Secure-aggregation protocol rollup from 'secagg' events (schema
     v5, protocols/secagg.py): rounds under the protocol, dropout-
@@ -420,6 +474,9 @@ def summarize_run(events):
     sec = secagg_summary(events)
     if sec:
         out["secagg"] = sec
+    asy = async_summary(events)
+    if asy:
+        out["async"] = asy
     fx = forensics_summary(events)
     if fx:
         out["forensics"] = fx
@@ -500,6 +557,25 @@ def _print_run(path, s, out):
             out("    group sum norms (last round): "
                 + "  ".join(f"{x:.3f}"
                             for x in sec["group_sum_norms_last"]))
+    asy = s.get("async")
+    if asy:
+        out(f"  async rounds: {asy['rounds']}  delivered "
+            f"{asy['delivered_total']} total "
+            f"({asy['delivered_mean']}/round, {asy['empty_rounds']} "
+            f"empty)  evicted {asy['evicted_total']}  superseded "
+            f"{asy['superseded_total']}  quarantined "
+            f"{asy['quarantined_total']}  in-flight mean "
+            f"{asy['in_flight_mean']}  pending at end "
+            f"{asy['pending_last']}")
+        traj = "  ".join(str(d) for d in asy["delivered_per_round"])
+        out(f"    delivered per round: {traj}")
+        if "staleness_hist" in asy:
+            hist = asy["staleness_hist"]
+            mass = asy.get("weight_mass", [None] * len(hist))
+            out("    staleness   rows   weight mass")
+            for sname, (h, w) in enumerate(zip(hist, mass)):
+                wtxt = f"{w:11.3f}" if w is not None else "          -"
+                out(f"      s={sname}     {h:5d}  {wtxt}")
     fx = s.get("forensics")
     if fx:
         _print_forensics(fx, out, indent="  ")

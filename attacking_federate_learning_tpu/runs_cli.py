@@ -332,6 +332,51 @@ def cmd_forensics(reg, args):
     return forensics_main(fargs)
 
 
+def cmd_async(reg, args):
+    """Registry-resolved staleness table (report.py:async_summary):
+    per-round delivered counts, the aggregate staleness histogram and
+    the weight mass per staleness bucket from a run's v7 'async'
+    stream.  Exit 1 when the run carries no async events (a
+    synchronous run)."""
+    import json as _json
+
+    from attacking_federate_learning_tpu.report import (
+        async_summary, load_events
+    )
+
+    e = reg.resolve(args.query, args.filter)
+    events = e.get("events")
+    if not isinstance(events, str) or not os.path.exists(events):
+        print(f"run {e['run_id']} has no readable event log "
+              f"(events={events!r})")
+        return 1
+    asy = async_summary(load_events([events], skip_bad=True))
+    if asy is None:
+        print(f"run {e['run_id']}: no 'async' events — the staleness "
+              f"table needs an --aggregation async run")
+        return 1
+    if args.json:
+        print(_json.dumps({e["run_id"]: asy}))
+        return 0
+    print(f"== {e['run_id']} ==")
+    print(f"  async rounds {asy['rounds']}: delivered "
+          f"{asy['delivered_total']} ({asy['delivered_mean']}/round, "
+          f"{asy['empty_rounds']} empty), evicted "
+          f"{asy['evicted_total']}, superseded "
+          f"{asy['superseded_total']}, quarantined "
+          f"{asy['quarantined_total']}")
+    print("  delivered per round: "
+          + "  ".join(str(d) for d in asy["delivered_per_round"]))
+    if "staleness_hist" in asy:
+        mass = asy.get("weight_mass",
+                       [None] * len(asy["staleness_hist"]))
+        print("  staleness   rows   weight mass")
+        for s, (h, w) in enumerate(zip(asy["staleness_hist"], mass)):
+            wtxt = f"{w:11.3f}" if w is not None else "          -"
+            print(f"    s={s}     {h:5d}  {wtxt}")
+    return 0
+
+
 def cmd_selfcheck(reg, args):
     """CI self-check (tools/smoke.sh leg 6): two refreshes must agree
     (incremental refresh is idempotent over an unchanged store), every
@@ -431,6 +476,12 @@ def main(argv=None) -> int:
                     help="append the v6 'forensics' verdict event to "
                          "this run log")
     sp.set_defaults(fn=cmd_forensics)
+    sp = sub.add_parser("async",
+                        help="staleness table from v7 'async' events "
+                             "(--aggregation async runs; report.py "
+                             "async_summary)")
+    sp.add_argument("query")
+    sp.set_defaults(fn=cmd_async)
     sp = sub.add_parser("selfcheck",
                         help="CI: refresh idempotence + resolvability")
     sp.set_defaults(fn=cmd_selfcheck)

@@ -38,7 +38,12 @@ record per hierarchical round under --telemetry: the stacked per-shard
 tier-1 diagnostics and the tier-2 cross-shard selection/trim
 diagnostics, with the static placement ground truth riding along) and
 ``forensics`` (the colluder-localization verdict `report forensics`
-computes from a run's shard_selection stream).
+computes from a run's shard_selection stream); v7 adds ``async`` —
+one asynchronous-round record per round under
+``aggregation='async'`` (core/async_rounds.py: delivered / pending /
+in-flight counts, evictions, supersessions, the delivered staleness
+histogram and the weight mass per staleness bucket — emitted with or
+without --telemetry, like 'fault').
 Readers accept every version; older logs simply never carry the newer
 kinds, and a newer-only kind stamped with an older version is an
 emitter bug, rejected (``KIND_MIN_VERSION``).
@@ -56,8 +61,8 @@ from typing import Optional
 import numpy as np
 
 
-SCHEMA_VERSION = 6
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6)
+SCHEMA_VERSION = 7
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
 
 # kind -> required fields.  Producers: core/engine.py (round, eval, asr,
 # profile, stream, defense, attack, selection_hist via RunLogger).
@@ -135,6 +140,13 @@ EVENT_KINDS = {
     # attribution: which shards were rejected, when localization
     # stabilized, whether the malicious shards were isolated)
     "forensics": {"verdict"},
+    # --- v7: asynchronous buffered rounds (core/async_rounds.py) --------
+    # one record per async round (emitted with or without --telemetry,
+    # like 'fault'): delivered / pending / in-flight counts, over-stale
+    # evictions, supersessions, quarantined non-finite arrivals, the
+    # delivered staleness histogram and the per-bucket weight mass —
+    # the staleness-rollup raw material ('report' staleness table)
+    "async": {"round", "delivered"},
 }
 
 # Minimum schema version per kind introduced after v1; an event carrying
@@ -142,7 +154,8 @@ EVENT_KINDS = {
 # older writer cannot know these kinds).
 KIND_MIN_VERSION = {"compile": 2, "cost": 2, "heartbeat": 2,
                     "lifecycle": 3, "registry": 4, "gate": 4,
-                    "secagg": 5, "shard_selection": 6, "forensics": 6}
+                    "secagg": 5, "shard_selection": 6, "forensics": 6,
+                    "async": 7}
 
 # Back-compat alias (pre-v3 spelling used by external readers).
 V2_KINDS = {k for k, v in KIND_MIN_VERSION.items() if v == 2}

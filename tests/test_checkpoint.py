@@ -114,6 +114,37 @@ def test_resume_roundtrips_extra_state(tmp_path):
     assert int(ckpt.resume(path).round) == 7
 
 
+def test_resume_roundtrips_multi_array_extra(tmp_path):
+    """ISSUE 9 satellite: the async engine checkpoints a MULTI-ARRAY
+    carry (f32 ring + pending buffers, bool occupancy masks, int32
+    birth/staleness counters) through the same ``extra=`` seam — every
+    array and every dtype must survive the npz round trip, not just
+    the single fault ring the pre-async tests exercised."""
+    import jax.numpy as jnp
+
+    from attacking_federate_learning_tpu.core.server import ServerState
+
+    cfg = cfg_for(tmp_path)
+    ckpt = Checkpointer(cfg)
+    rng = np.random.default_rng(0)
+    extra_in = {
+        "async_buf": rng.normal(size=(3, 4, 5)).astype(np.float32),
+        "async_occ": rng.random((3, 4)) > 0.5,
+        "async_birth": rng.integers(0, 9, (3, 4)).astype(np.int32),
+        "async_pbuf": rng.normal(size=(4, 5)).astype(np.float32),
+        "async_pocc": rng.random(4) > 0.5,
+        "async_pbirth": rng.integers(0, 9, 4).astype(np.int32),
+    }
+    state = ServerState(weights=jnp.ones(5), velocity=jnp.zeros(5),
+                        round=jnp.asarray(3, jnp.int32))
+    path = ckpt.save_auto(state, extra=extra_in)
+    _, extra = ckpt.resume(path, with_extra=True)
+    assert set(extra) == set(extra_in)
+    for k, v in extra_in.items():
+        assert extra[k].dtype == v.dtype, k
+        np.testing.assert_array_equal(extra[k], v)
+
+
 def test_resume_continues_bit_for_bit(tmp_path):
     cfg = cfg_for(tmp_path)
 

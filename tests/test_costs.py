@@ -257,9 +257,16 @@ def test_check_events_handles_v2_and_unknown_versions(tmp_path):
     assert not errors
     assert counts == {"compile": 1, "cost": 1, "heartbeat": 1}
     assert ce.main([path]) == 0
+    from attacking_federate_learning_tpu.utils.metrics import (
+        SUPPORTED_VERSIONS
+    )
+
     bad = os.path.join(str(tmp_path), "future.jsonl")
     with open(bad, "w") as f:
-        f.write(json.dumps({"kind": "quantum_trace", "v": 7}) + "\n")
+        # One past the newest supported version — stays "the future"
+        # across schema bumps instead of hard-coding a constant.
+        f.write(json.dumps({"kind": "quantum_trace",
+                            "v": max(SUPPORTED_VERSIONS) + 1}) + "\n")
     counts, legacy, errors = ce.check_file(bad)
     assert len(errors) == 1 and "newer writer" in errors[0][1]
     assert ce.main([bad]) == 1

@@ -221,8 +221,16 @@ def test_fault_requires_mask_aware_defense(tmp_path):
             attacker=DriftAttack(1.0))
 
 
-def test_straggler_requires_full_participation(tmp_path):
-    with pytest.raises(ValueError, match="participation"):
+@pytest.mark.parametrize("match", [
+    "participation",
+    # ISSUE 9 satellite: the rejection must name --aggregation async
+    # as the supported straggler route (stragglers become extra
+    # arrival delay in the buffered round, core/async_rounds.py).
+    "aggregation async",
+    "extra arrival delay",
+])
+def test_straggler_requires_full_participation(tmp_path, match):
+    with pytest.raises(ValueError, match=match):
         FederatedExperiment(
             _cfg(tmp_path, participation=0.5,
                  faults=FaultConfig(straggler=0.1)),

@@ -85,6 +85,27 @@ def test_degradation_ladder_stall_staged_on_repeat(tmp_path):
     assert s.degrade_for("stall") is None      # applied once
 
 
+def test_degradation_ladder_async_falls_back_to_sync_first(tmp_path):
+    """ISSUE 9 satellite: an async-mode stall degrades to synchronous
+    rounds (--aggregation flat) BEFORE the staged per-round fallback —
+    the buffered span is the largest program the async engine
+    compiles, and the sync path is the known-good baseline."""
+    sup = _load("supervisor")
+    s = _sup(sup, CHILD + ["--aggregation", "async",
+                           "--async-buffer", "8"],
+             events=str(tmp_path / "e.jsonl"))
+    s.class_counts["stall"] = 1
+    assert s.degrade_for("stall") is None      # first stall: retry only
+    s.class_counts["stall"] = 2
+    assert s.degrade_for("stall") == "async_sync_fallback"
+    assert s.degrade_flags[-2:] == ["--aggregation", "flat"]
+    assert s._effective_ns().aggregation == "flat"
+    # A further stall takes the staged step — the last resort.
+    s.class_counts["stall"] = 3
+    assert s.degrade_for("stall") == "staged_fallback"
+    assert "--backdoor-staged" in s.degrade_flags
+
+
 def test_backoff_exponential_and_preempt_free(tmp_path):
     sup = _load("supervisor")
     s = _sup(sup, CHILD, backoff_base=2.0, backoff_max=9.0,

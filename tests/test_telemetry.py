@@ -308,7 +308,15 @@ def test_schema_roundtrip_every_engine_kind(tmp_path):
                      secagg="groupwise", aggregation="hierarchical",
                      megabatch=4, tier2_defense="Krum", telemetry=True)
     _, ev5 = _run(cfg5, tmp_path, "roundtrip5")
-    for rec in ev1 + ev2 + ev3 + ev4 + ev5:
+    # Run 6: asynchronous buffered rounds — the v7 'async' kind from a
+    # real engine run (core/async_rounds.py; staleness-weighted Krum).
+    cfg6 = _tele_cfg(tmp_path, users_count=12, mal_prop=0.25,
+                     defense="Krum", epochs=4, test_step=4,
+                     aggregation="async", async_buffer=7,
+                     async_max_staleness=2, staleness_weight="poly",
+                     telemetry=True)
+    _, ev6 = _run(cfg6, tmp_path, "roundtrip6")
+    for rec in ev1 + ev2 + ev3 + ev4 + ev5 + ev6:
         validate_event(rec)
         assert rec["v"] == SCHEMA_VERSION
         seen.add(rec["kind"])

@@ -16,7 +16,9 @@ v5's 'secagg' kind: one secure-aggregation protocol record per round,
 protocols/secagg.py — plus v6's hierarchical-forensics kinds:
 'shard_selection' per-round tier-1/tier-2 selection records from
 hierarchical rounds under --telemetry, core/engine.py, and
-'forensics' colluder-localization verdicts, report.py).  An
+'forensics' colluder-localization verdicts, report.py — plus v7's
+'async' kind: one asynchronous-round record per round under
+aggregation='async', core/async_rounds.py).  An
 event stamped with a
 version this reader does not know is reported as "produced by a newer
 writer" — a clear per-line error, never a KeyError — and a newer-only

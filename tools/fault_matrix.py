@@ -127,10 +127,97 @@ def check_cell(path, cfg, epochs):
     return errors
 
 
+def run_async_cell(defense, epochs, users, log_dir, dropout=0.2,
+                   async_buffer=8):
+    """ISSUE 9 satellite: the dropout × async-buffer smoke leg.  One
+    short aggregation='async' run under dropout faults, then three
+    closures: the log schema-validates, every round carries a v7
+    'async' event whose delivery dynamics match the host replay
+    (core/async_rounds.py:replay_schedule), and the emitted
+    'fault' dropout counts match the shared fault_masks schedule.
+    Returns a list of error strings (empty = pass)."""
+    import importlib.util
+
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.attacks import DriftAttack
+    from attacking_federate_learning_tpu.config import (
+        ExperimentConfig, FaultConfig
+    )
+    from attacking_federate_learning_tpu.core.async_rounds import (
+        replay_schedule
+    )
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+    from attacking_federate_learning_tpu.utils.metrics import (
+        RunLogger, iter_events
+    )
+
+    cfg = ExperimentConfig(
+        dataset=C.SYNTH_MNIST, users_count=users,
+        mal_prop=0.2 if users >= 15 else 0.1,
+        batch_size=16, epochs=epochs, test_step=epochs,
+        defense=defense, synth_train=256, synth_test=64,
+        aggregation="async", async_buffer=async_buffer,
+        async_max_staleness=2, staleness_weight="poly",
+        faults=FaultConfig(dropout=dropout), log_dir=log_dir)
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=256, synth_test=64)
+    exp = FederatedExperiment(cfg, attacker=DriftAttack(1.0), dataset=ds)
+    name = f"fault_matrix_async_{defense}"
+    path = os.path.join(log_dir, name + ".jsonl")
+    try:
+        with RunLogger(cfg, None, log_dir, jsonl_name=name) as logger:
+            exp.run(logger)
+    except Exception as e:                        # noqa: BLE001
+        return [f"raised: {e}"]
+
+    spec = importlib.util.spec_from_file_location(
+        "check_events", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "check_events.py"))
+    ce = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ce)
+    errors = []
+    _, _, bad_lines = ce.check_file(path)
+    errors += [f"line {ln}: {msg}" for ln, msg in bad_lines]
+
+    asyncs, faults = [], []
+    for e in iter_events(path):
+        if e["kind"] == "async":
+            asyncs.append(e)
+        elif e["kind"] == "fault" and not e.get("rolled_back"):
+            faults.append(e)
+    if len(asyncs) != epochs:
+        errors.append(f"expected {epochs} async events, got "
+                      f"{len(asyncs)}")
+        return errors
+    rows = replay_schedule(cfg, exp.m, exp.m_mal, epochs)
+    for e, r in zip(sorted(asyncs, key=lambda e: e["round"]), rows):
+        for k in ("delivered", "pending", "evicted", "superseded"):
+            if int(e[k]) != r[k]:
+                errors.append(f"round {e['round']}: async {k} emitted "
+                              f"{e[k]} != replayed {r[k]}")
+        if [int(x) for x in e["staleness_hist"]] != r["staleness_hist"]:
+            errors.append(f"round {e['round']}: staleness_hist "
+                          f"{e['staleness_hist']} != "
+                          f"{r['staleness_hist']}")
+    want = expected_schedule(cfg, exp.m, exp.m_mal, epochs)
+    for got, exp_row in zip(sorted(faults, key=lambda e: e["round"]),
+                            want):
+        if int(got.get("injected_dropout", -1)) != exp_row[
+                "injected_dropout"]:
+            errors.append(
+                f"round {got['round']}: injected_dropout "
+                f"{got.get('injected_dropout')} != scheduled "
+                f"{exp_row['injected_dropout']}")
+    return errors
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="5-round fault x defense smoke sweep with schedule "
-                    "validation (core/faults.py).")
+                    "validation (core/faults.py), plus the dropout x "
+                    "async-buffer leg (core/async_rounds.py).")
     p.add_argument("--epochs", type=int, default=5)
     p.add_argument("--users", type=int, default=15)
     p.add_argument("--defenses", default=",".join(MASK_AWARE_DEFENSES),
@@ -139,6 +226,8 @@ def main(argv=None) -> int:
     p.add_argument("--dropout", type=float, default=0.2)
     p.add_argument("--straggler", type=float, default=0.1)
     p.add_argument("--corrupt", type=float, default=0.05)
+    p.add_argument("--no-async", action="store_true",
+                   help="skip the dropout x async-buffer smoke leg")
     p.add_argument("--log-dir", default=None,
                    help="where run JSONLs land (default: a temp dir)")
     args = p.parse_args(argv)
@@ -161,6 +250,18 @@ def main(argv=None) -> int:
         else:
             print(f"ok   {defense}: {args.epochs} rounds, fault events "
                   f"match the injected schedule  ({path})")
+    if not args.no_async:
+        errors = run_async_cell("Krum", args.epochs, args.users,
+                                log_dir, dropout=args.dropout)
+        if errors:
+            failed = True
+            print(f"FAIL async(Krum): {len(errors)} problem(s)")
+            for e in errors[:10]:
+                print(f"  {e}")
+        else:
+            print(f"ok   async(Krum): {args.epochs} rounds, dropout x "
+                  f"async-buffer — async + fault events match the "
+                  f"replayed schedule")
     return 1 if failed else 0
 
 

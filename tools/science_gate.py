@@ -106,6 +106,21 @@ CELLS = {
                                      megabatch=5,
                                      mal_placement="concentrated",
                                      telemetry=True),
+    # --- PR 9: asynchronous buffered rounds (ISSUE 9, core/
+    # async_rounds.py).  The behavioral-test constants under the
+    # FedBuff regime: k=12 of n=19 aggregated per applied round,
+    # staleness bound 2, poly weighting.  The clean NoDefense cell is
+    # a pure deterministic replay (no selection anywhere — the FIFO
+    # order is PRNG-fixed), band 0; the Krum×ALIE cell is
+    # selection-mediated, banded like the sync krum cells.
+    "async_nodefense_clean": dict(defense="NoDefense", attack=None,
+                                  aggregation="async", async_buffer=12,
+                                  async_max_staleness=2,
+                                  staleness_weight="poly"),
+    "async_krum_alie15": dict(defense="Krum", z=1.5,
+                              aggregation="async", async_buffer=12,
+                              async_max_staleness=2,
+                              staleness_weight="poly"),
 }
 
 # Per-metric tolerance bands (absolute; 0 = exact).  Authored here,
@@ -149,6 +164,11 @@ CELL_BANDS = {
                                  "stabilized_round": 2.0,
                                  "mal_rejected_rounds": 2.0,
                                  "tier2_malicious_share": 0.05},
+    # async_nodefense_clean is exact (band 0 via DEFAULT_BANDS): the
+    # weighted mean + deterministic FIFO replay bit-for-bit.  The
+    # async Krum cell is selection-mediated (delivered-cohort Krum
+    # picks rest on the same f32 near-ties as the sync cells).
+    "async_krum_alie15": {"final_accuracy": 3.0, "max_accuracy": 3.0},
 }
 
 
@@ -199,7 +219,10 @@ def measure_cell(name: str, spec: dict, rounds: int = ROUNDS) -> dict:
         aggregation=spec.get("aggregation", "flat"),
         megabatch=spec.get("megabatch", 0),
         tier2_defense=spec.get("tier2_defense"),
-        mal_placement=spec.get("mal_placement", "spread"))
+        mal_placement=spec.get("mal_placement", "spread"),
+        async_buffer=spec.get("async_buffer", 0),
+        async_max_staleness=spec.get("async_max_staleness", 2),
+        staleness_weight=spec.get("staleness_weight", "none"))
     ds = load_dataset(cfg.dataset, seed=0, synth_train=cfg.synth_train,
                       synth_test=cfg.synth_test)
     if backdoor:

@@ -2,19 +2,22 @@
 # One-liner CI smoke: event-schema validation + fault matrix + crash
 # matrix + perf gate (incl. hierarchical memproof + secagg wireproof) +
 # science gate + registry selfcheck + hierarchical-aggregation smoke +
-# secure-aggregation smoke + hierarchical-telemetry/forensics smoke.
+# secure-aggregation smoke + hierarchical-telemetry/forensics smoke +
+# asynchronous-rounds smoke.
 #
-#   bash tools/smoke.sh            # all nine, CPU-pinned
+#   bash tools/smoke.sh            # all ten, CPU-pinned
 #   bash tools/smoke.sh --fast     # skip the fault + crash matrices
 #                                  # (the two slowest legs)
 #
 # Legs (each independently CI-wired through tests/ as well):
 #   1. tools/check_events.py over every run JSONL in logs/ (schema
-#      v1-v6: round/eval/.../fault, compile/cost/heartbeat, lifecycle,
-#      registry/gate, secagg, shard_selection/forensics) — skipped
-#      when logs/ has no .jsonl yet;
+#      v1-v7: round/eval/.../fault, compile/cost/heartbeat, lifecycle,
+#      registry/gate, secagg, shard_selection/forensics, async) —
+#      skipped when logs/ has no .jsonl yet;
 #   2. tools/fault_matrix.py — 5-round fault x defense sweep, emitted
-#      'fault' events diffed against the host replay of the schedule;
+#      'fault' events diffed against the host replay of the schedule,
+#      plus the dropout x async-buffer leg (async + fault events
+#      diffed against core/async_rounds.py:replay_schedule);
 #   3. tools/crash_matrix.py — supervised preempt/resume at a seeded
 #      round x {fused, staged, faulted} x 2 defenses: bounded retries,
 #      exactly-once journal, clean exit (tools/supervisor.py);
@@ -41,7 +44,13 @@
 #      hierarchical x Krum run with --telemetry (schema-v6
 #      'shard_selection' events), check_events over its private log,
 #      'report forensics' exit-0, and a 'runs trace' export (the
-#      exporter validates the trace before writing).
+#      exporter validates the trace before writing);
+#  10. asynchronous-rounds smoke — a journaled 5-round
+#      --aggregation async x {Krum, TrimmedMean} run each (FedBuff
+#      buffered rounds, core/async_rounds.py), then RunJournal.verify
+#      (every round and eval exactly once), check_events over the
+#      private logs (v7 'async' events), and an async-event audit:
+#      one per round, every delivered round exactly k rows.
 #
 # Exit: nonzero if any leg fails.  Always CPU (the gates' baselines are
 # CPU artifacts, and the matrices must not touch a TPU capture).
@@ -56,32 +65,32 @@ fail=0
 shopt -s nullglob
 jsonls=(logs/*.jsonl)
 if [ ${#jsonls[@]} -gt 0 ]; then
-    echo "== smoke 1/9: check_events (${#jsonls[@]} logs) =="
+    echo "== smoke 1/10: check_events (${#jsonls[@]} logs) =="
     python tools/check_events.py "${jsonls[@]}" || fail=1
 else
-    echo "== smoke 1/9: check_events — no logs/*.jsonl yet, skipped =="
+    echo "== smoke 1/10: check_events — no logs/*.jsonl yet, skipped =="
 fi
 
 crash_work=""
 if [ "${1:-}" != "--fast" ]; then
-    echo "== smoke 2/9: fault_matrix =="
+    echo "== smoke 2/10: fault_matrix =="
     python tools/fault_matrix.py || fail=1
-    echo "== smoke 3/9: crash_matrix (supervised preempt/resume) =="
+    echo "== smoke 3/10: crash_matrix (supervised preempt/resume) =="
     # Keep the matrix's run stores: leg 6 registry-checks them.
     crash_work="$(mktemp -d -t crash_matrix_XXXXXX)"
     python tools/crash_matrix.py --workdir "$crash_work" || fail=1
 else
-    echo "== smoke 2/9: fault_matrix — skipped (--fast) =="
-    echo "== smoke 3/9: crash_matrix — skipped (--fast) =="
+    echo "== smoke 2/10: fault_matrix — skipped (--fast) =="
+    echo "== smoke 3/10: crash_matrix — skipped (--fast) =="
 fi
 
-echo "== smoke 4/9: perf_gate (+ hierarchical memproof) =="
+echo "== smoke 4/10: perf_gate (+ hierarchical memproof) =="
 python tools/perf_gate.py --memproof || fail=1
 
-echo "== smoke 5/9: science_gate (behavioral drift) =="
+echo "== smoke 5/10: science_gate (behavioral drift) =="
 python tools/science_gate.py || fail=1
 
-echo "== smoke 6/9: runs selfcheck (registry) =="
+echo "== smoke 6/10: runs selfcheck (registry) =="
 python -m attacking_federate_learning_tpu.cli runs selfcheck || fail=1
 if [ -n "$crash_work" ]; then
     # The registry over the crash matrix's preempt/resume artifacts:
@@ -98,7 +107,7 @@ if [ -n "$crash_work" ]; then
     rm -rf "$crash_work"
 fi
 
-echo "== smoke 7/9: hierarchical aggregation (journaled, audited) =="
+echo "== smoke 7/10: hierarchical aggregation (journaled, audited) =="
 hier_work="$(mktemp -d -t hier_smoke_XXXXXX)"
 for def in Krum TrimmedMean; do
     python -m attacking_federate_learning_tpu.cli \
@@ -124,7 +133,7 @@ sys.exit(bad)
 PY
 rm -rf "$hier_work"
 
-echo "== smoke 8/9: secure aggregation (journaled, audited) =="
+echo "== smoke 8/10: secure aggregation (journaled, audited) =="
 sa_work="$(mktemp -d -t secagg_smoke_XXXXXX)"
 # vanilla: one dropout-rate high enough that the 5-round seeded run is
 # guaranteed (and pinned by the audit below) to include at least one
@@ -173,7 +182,7 @@ sys.exit(bad)
 PY
 rm -rf "$sa_work"
 
-echo "== smoke 9/9: hierarchical telemetry + forensics (journaled) =="
+echo "== smoke 9/10: hierarchical telemetry + forensics (journaled) =="
 fx_work="$(mktemp -d -t hier_tele_smoke_XXXXXX)"
 # 5-round journaled hierarchical x Krum run with --telemetry: the run
 # must emit one schema-v6 'shard_selection' event per round.
@@ -194,7 +203,7 @@ events = [json.loads(line) for line in
           open(os.path.join(sys.argv[1], "logs",
                             "hier_tele_smoke.jsonl"))]
 ss = [e for e in events if e.get("kind") == "shard_selection"]
-ok = (len(ss) == 5 and all(e.get("v") == 6 for e in ss)
+ok = (len(ss) == 5 and all(e.get("v") >= 6 for e in ss)
       and all("tier2_selection_mask" in e for e in ss))
 print(f"  shard_selection events: {len(ss)}/5 "
       f"({'ok' if ok else 'FAIL'})")
@@ -209,6 +218,56 @@ python -m attacking_federate_learning_tpu.cli runs \
     --run-dir "$fx_work/runs" --bench '' --progress '' \
     trace hier_tele_smoke -o "$fx_work/trace.json" || fail=1
 rm -rf "$fx_work"
+
+echo "== smoke 10/10: asynchronous rounds (journaled, audited) =="
+as_work="$(mktemp -d -t async_smoke_XXXXXX)"
+# 5-round journaled FedBuff runs: k=8 of n=12 aggregated per applied
+# round, staleness bound 2, poly weighting, Krum + TrimmedMean.
+for def in Krum TrimmedMean; do
+    python -m attacking_federate_learning_tpu.cli \
+        -d "$def" -s SYNTH_MNIST -n 12 -m 0.25 -c 16 -e 5 \
+        --synth-train 256 --synth-test 64 \
+        --aggregation async --async-buffer 8 --async-max-staleness 2 \
+        --staleness-weight poly \
+        --journal --run-id "async_${def}_smoke" --no-checkpoint \
+        --log-dir "$as_work/logs" --run-dir "$as_work/runs" \
+        > /dev/null || fail=1
+    # The private log must validate (v7 'async' events included).
+    python tools/check_events.py \
+        "$as_work/logs/async_${def}_smoke.jsonl" || fail=1
+done
+# Journal audit (exactly-once) + async-event audit: one v7 'async'
+# event per round, and every delivered round aggregates exactly k.
+python - "$as_work" <<'PY' || fail=1
+import json, os, sys
+from attacking_federate_learning_tpu.utils.lifecycle import RunJournal
+work = sys.argv[1]
+bad = 0
+for rid in ("async_Krum_smoke", "async_TrimmedMean_smoke"):
+    problems = RunJournal(os.path.join(work, "runs"), rid).verify(
+        epochs=5, test_step=5)
+    events = [json.loads(line) for line in
+              open(os.path.join(work, "logs", rid + ".jsonl"))]
+    av = [e for e in events if e.get("kind") == "async"]
+    if len(av) != 5:
+        problems.append(f"{len(av)} async events, want one per round")
+    if any(e.get("v") != 7 for e in av):
+        problems.append("async event not stamped v7")
+    if any(int(e.get("delivered", -1)) not in (0, 8) for e in av):
+        problems.append("a delivered round did not aggregate "
+                        "exactly k=8 rows")
+    if not any(int(e.get("delivered", 0)) == 8 for e in av):
+        problems.append("no round ever reached the FedBuff trigger")
+    status = "ok" if not problems else f"FAIL {problems}"
+    print(f"  async {rid}: {status}")
+    bad |= bool(problems)
+sys.exit(bad)
+PY
+# Registry-resolved staleness table must render (runs async verb).
+python -m attacking_federate_learning_tpu.cli runs \
+    --run-dir "$as_work/runs" --bench '' --progress '' \
+    async async_Krum_smoke || fail=1
+rm -rf "$as_work"
 
 if [ $fail -ne 0 ]; then
     echo "SMOKE FAILED"

@@ -25,9 +25,11 @@ Failure taxonomy (utils/lifecycle.py:classify_failure):
   from the child's event JSONL: the last heartbeat's last-event age,
   or the file mtime); the supervisor SIGTERMs (graceful: the child
   checkpoints at the next boundary), escalates to SIGKILL after
-  ``--stall-grace``.  A second stall falls back to the staged
-  per-round path (``--backdoor-staged``) — the repeated-compile-
-  timeout remedy.
+  ``--stall-grace``.  A second stall degrades: an async-mode run
+  falls back to synchronous rounds first (``--aggregation flat`` —
+  the buffered span is the largest program that engine compiles),
+  then the staged per-round path (``--backdoor-staged``) — the
+  repeated-compile-timeout remedy of last resort.
 - ``crash`` — anything else; plain retry with backoff.
 
 Exactly-once accounting: the child always runs with ``--journal`` and a
@@ -181,6 +183,18 @@ class Supervisor:
                 return "cpu_fallback"
             return None
         if cls == "stall" and self.class_counts.get("stall", 0) >= 2:
+            ns = self._effective_ns()
+            if (ns.aggregation == "async"
+                    and "--aggregation" not in self.degrade_flags):
+                # An async-mode stall falls back to synchronous rounds
+                # FIRST (--aggregation flat; argparse last-wins): the
+                # buffered span is the largest program the async
+                # engine compiles, and the sync path is the known-good
+                # baseline — the staged per-round fallback below stays
+                # the last resort.  (The async knobs are inert under
+                # flat, so no further flag surgery is needed.)
+                self.degrade_flags += ["--aggregation", "flat"]
+                return "async_sync_fallback"
             if "--backdoor-staged" not in self.degrade_flags:
                 # Repeated compile timeout: fall back to the staged
                 # per-round path (per-round host boundaries — smaller
