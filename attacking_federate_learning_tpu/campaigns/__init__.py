@@ -1,0 +1,25 @@
+"""Campaign engine: resumable, cache-aware scheduling of whole
+defense x attack x topology sweeps (ROADMAP item 5).
+
+The supervisor, run registry and exactly-once journal (PRs 4-5) make
+*single* runs durable; this package is the layer above — a scheduler
+that expands a declarative :class:`CampaignSpec` into config cells,
+pre-validates every cell against the engine's composition-rejection
+matrix, orders them for compile-cache locality, and executes them
+(in-process, grid-style, or through ``tools/supervisor.py``) under a
+campaign-level exactly-once journal, so a SIGKILL mid-campaign costs
+only the cell in flight.  ARCHITECTURE.md "Campaign engine" is the
+contract; ``runs campaign <id>`` renders the result tables from the
+run registry.
+"""
+
+from attacking_federate_learning_tpu.campaigns.journal import (  # noqa: F401
+    CampaignJournal, TERMINAL_STATES
+)
+from attacking_federate_learning_tpu.campaigns.scheduler import (  # noqa: F401
+    Campaign, EXIT_DEADLINE, order_cells
+)
+from attacking_federate_learning_tpu.campaigns.spec import (  # noqa: F401
+    CampaignSpec, Cell, apply_attack, cell_id_for,
+    composition_reject_reason, hlo_signature
+)

@@ -1,0 +1,4 @@
+from attacking_federate_learning_tpu.campaigns.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -490,6 +490,15 @@ def main(argv=None):
         from attacking_federate_learning_tpu.report import main as report_main
 
         return report_main(argv[1:])
+    if argv and argv[0] == "campaign":
+        # Campaign scheduler subcommand (campaigns/cli.py): run a
+        # declarative sweep spec as resumable, cache-aware cells.
+        # Heavy imports stay lazy so --dry-run/plan paths touch no jax.
+        from attacking_federate_learning_tpu.campaigns.cli import (
+            main as campaign_main
+        )
+
+        return campaign_main(argv[1:])
     if argv and argv[0] == "runs":
         # Cross-run registry subcommand (runs_cli.py): list/show/diff/
         # compare/tag/trace/forensics/selfcheck over runs/index.jsonl

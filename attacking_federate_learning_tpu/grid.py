@@ -1,22 +1,34 @@
-"""Attack x defense grid runner.
+"""Attack x defense grid runner — a thin campaign-spec wrapper.
 
 The reference explores its attack/defense matrix by hand, one
-``python main.py`` at a time (readme.md:23-28).  This driver runs the whole
-grid in one process — model/data/compile caches shared across cells, one
-JSONL summary — which is what makes the "full grid overnight" target
-(BASELINE.md) a single command:
+``python main.py`` at a time (readme.md:23-28).  This driver compiles
+its flag surface into a :class:`CampaignSpec` (campaigns/spec.py) and
+delegates to the campaign engine's inline executor (campaigns/
+scheduler.py) — the same sweep code path the campaign CLI, the fault
+matrix and ``runs campaign`` use — while preserving the historical
+contract: cells run in spec order in ONE process (model/data/compile
+caches shared), every cell appends one JSON line to the summary as it
+finishes, and composition rejections record as skipped cells instead
+of killing the sweep:
 
     python -m attacking_federate_learning_tpu.grid --epochs 100 -s MNIST
+
+Cell ids are ``cell_id_for(cfg, attack)`` — the config-hash
+``run_id_for`` join key extended with the attack name, because the
+plain config hash collapses attacks that share a config (signflip vs
+alie).  Under ``--journal`` the sweep becomes a persisted campaign:
+exactly-once cell accounting under ``runs/campaigns/<id>/``, per-run
+journals + registry stamps (so ``runs campaign <id>`` renders the
+grid table straight from the registry), and a re-invoke completes
+only the remaining cells.
 """
 
 from __future__ import annotations
 
 import argparse
 import dataclasses
-import itertools
 import json
 import os
-import time
 
 from attacking_federate_learning_tpu import config as C
 from attacking_federate_learning_tpu.config import ExperimentConfig
@@ -37,84 +49,81 @@ def _all_attacks():
     return ["none"] + [n for n in names if n != "none"]
 
 
-def run_grid(base: ExperimentConfig, defenses=None, attacks=None,
-             out_path=None):
-    from attacking_federate_learning_tpu.attacks import make_attacker
-    from attacking_federate_learning_tpu.core.engine import (
-        FederatedExperiment
+def grid_spec(base: ExperimentConfig, defenses=None,
+              attacks=None) -> "CampaignSpec":
+    """The grid flag surface as a campaign spec (defense x attack axes
+    over the base config)."""
+    from attacking_federate_learning_tpu.campaigns.spec import (
+        CampaignSpec
     )
-    from attacking_federate_learning_tpu.data.datasets import load_dataset
-    from attacking_federate_learning_tpu.utils.lifecycle import run_id_for
-    from attacking_federate_learning_tpu.utils.metrics import RunLogger
 
-    defenses = defenses or _all_defenses()
-    attacks = attacks or _all_attacks()
-    dataset = load_dataset(base.dataset, base.data_dir, base.seed,
-                           synth_train=base.synth_train,
-                           synth_test=base.synth_test)
+    return CampaignSpec(
+        name="grid",
+        base=dataclasses.asdict(base),
+        axes={"defense": list(defenses or _all_defenses()),
+              "attack": list(attacks or _all_attacks())},
+        order="spec")
+
+
+def _grid_row(cell, row) -> dict:
+    """One campaign cell record in the historical grid summary shape."""
+    rec = {"defense": (cell.cfg.defense if cell.cfg is not None
+                       else cell.overrides.get("defense")),
+           "attack": cell.attack}
+    state = row["state"]
+    if state == "skipped":
+        rec["skipped"] = row.get("reason")
+        if cell.cfg is not None:  # config-level rejections have no
+            rec["run_id"] = cell.cell_id  # config hash to join on
+        return rec
+    rec["run_id"] = cell.cell_id
+    if state == "failed":
+        rec["failed"] = row.get("reason")
+        rec["wall_s"] = row.get("wall_s")
+        return rec
+    rec["final_accuracy"] = row.get("final_accuracy")
+    rec["max_accuracy"] = row.get("max_accuracy")
+    rec["rounds"] = row.get("rounds")
+    rec["wall_s"] = row.get("wall_s")
+    if "final_asr" in row:
+        rec["final_asr"] = row["final_asr"]
+    return rec
+
+
+def run_grid(base: ExperimentConfig, defenses=None, attacks=None,
+             out_path=None, journal=False, order="spec"):
+    """Run the grid as an inline campaign; returns the summary rows.
+
+    ``journal=False`` (the historical default) keeps the sweep
+    ephemeral — no runs/ artifacts, just the summary JSONL;
+    ``journal=True`` persists the campaign journal + per-run journals
+    and makes the sweep resumable."""
+    from attacking_federate_learning_tpu.campaigns.scheduler import (
+        Campaign
+    )
+
+    spec = grid_spec(base, defenses, attacks)
     os.makedirs(base.log_dir, exist_ok=True)
     out_path = out_path or os.path.join(base.log_dir, "grid_summary.jsonl")
     results = []
     summary = open(out_path, "w")
 
-    def emit(cell):
-        # Append per cell so a failing cell can't discard finished results.
-        results.append(cell)
-        summary.write(json.dumps(cell) + "\n")
+    def on_cell(cell, row):
+        # Append per cell so a failing cell can't discard finished
+        # results (the historical incremental-summary contract).
+        rec = _grid_row(cell, row)
+        results.append(rec)
+        summary.write(json.dumps(rec) + "\n")
         summary.flush()
-        print(json.dumps(cell), flush=True)
+        print(json.dumps(rec), flush=True)
 
-    for defense, attack in itertools.product(defenses, attacks):
-        run_id = None
-        try:
-            # Construction inside the try: composition rejections
-            # (defense validity bounds, and since PR 7 the secagg
-            # visibility rules — a robust defense under --secagg is a
-            # ValueError at config time) record as skipped cells
-            # instead of killing the sweep.
-            cfg = dataclasses.replace(
-                base, defense=defense,
-                backdoor="pattern" if attack == "backdoor" else False,
-                num_std=0.0 if attack == "none" else base.num_std,
-                mal_prop=0.0 if attack == "none" else base.mal_prop)
-            # Config-hash identity (utils/lifecycle.py): the join key
-            # between a GRID row and the run registry (runs/index.jsonl).
-            run_id = run_id_for(cfg)
-            attacker = make_attacker(cfg, dataset=dataset,
-                                     name=attack)
-            exp = FederatedExperiment(cfg, attacker=attacker,
-                                      dataset=dataset)
-        except ValueError as e:  # composition guard — record & skip
-            cell = {"defense": defense, "attack": attack,
-                    "skipped": str(e)}
-            if run_id is not None:  # config-level rejections have no
-                cell["run_id"] = run_id  # config hash to join on
-            emit(cell)
-            continue
-        t0 = time.time()
-        try:
-            # Context-managed: a cell that dies still closes its JSONL
-            # and flushes its accuracy CSV (utils/metrics.py:RunLogger).
-            with RunLogger(cfg, cfg.output, cfg.log_dir,
-                           jsonl_name=f"grid_{defense}_{attack}") as logger:
-                out = exp.run(logger)
-        except FloatingPointError as e:  # backdoor nan guard — record cell
-            emit({"defense": defense, "attack": attack,
-                  "run_id": run_id, "failed": str(e),
-                  "wall_s": round(time.time() - t0, 2)})
-            continue
-        cell = {
-            "defense": defense, "attack": attack, "run_id": run_id,
-            "final_accuracy": out["accuracies"][-1],
-            "max_accuracy": max(out["accuracies"]),
-            "rounds": cfg.epochs,
-            "wall_s": round(time.time() - t0, 2),
-        }
-        if attack == "backdoor":
-            cell["final_asr"] = exp.attacker.test_asr(exp.state.weights)
-        emit(cell)
-
-    summary.close()
+    camp = Campaign(spec, executor="inline", order=order,
+                    journal_runs=journal, persist=journal,
+                    on_cell=on_cell)
+    try:
+        camp.run()
+    finally:
+        summary.close()
     return results
 
 
@@ -148,9 +157,22 @@ def main(argv=None):
     p.add_argument("--synth-test", default=ExperimentConfig.synth_test,
                    type=int)
     p.add_argument("--log-dir", default="logs", type=str)
+    p.add_argument("--run-dir", default="runs", type=str,
+                   help="campaign + run journal root (used with "
+                        "--journal)")
     p.add_argument("--out", default=None, type=str,
                    help="summary JSONL path (default <log-dir>/"
                         "grid_summary.jsonl)")
+    p.add_argument("--journal", action="store_true",
+                   help="persist the sweep as a campaign: exactly-once "
+                        "cell accounting under runs/campaigns/<id>/, "
+                        "per-run journals + registry stamps, resumable "
+                        "re-invocation ('runs campaign <id>' renders "
+                        "the table)")
+    p.add_argument("--order", default="spec",
+                   choices=["spec", "grouped", "shuffled"],
+                   help="cell execution order (campaigns/scheduler.py; "
+                        "'spec' preserves the historical product order)")
     args = p.parse_args(argv)
 
     from attacking_federate_learning_tpu.cli import apply_backend
@@ -161,6 +183,7 @@ def main(argv=None):
                             mal_prop=args.mal_prop, epochs=args.epochs,
                             batch_size=args.batch_size, seed=args.seed,
                             backend=args.backend, log_dir=args.log_dir,
+                            run_dir=args.run_dir,
                             synth_train=args.synth_train,
                             synth_test=args.synth_test,
                             secagg=args.secagg,
@@ -168,7 +191,8 @@ def main(argv=None):
                             megabatch=args.megabatch,
                             tier2_defense=args.tier2_defense,
                             mal_placement=args.mal_placement)
-    run_grid(base, args.defenses, args.attacks, out_path=args.out)
+    run_grid(base, args.defenses, args.attacks, out_path=args.out,
+             journal=args.journal, order=args.order)
 
 
 if __name__ == "__main__":

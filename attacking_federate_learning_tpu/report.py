@@ -629,6 +629,99 @@ def _print_run(path, s, out):
                                      for k, v in s["stream"].items()))
 
 
+def campaign_table(manifest, registry_entries=None) -> dict:
+    """Defense x attack table for one campaign manifest
+    (campaigns/journal.py), with metric values taken from the CROSS-RUN
+    REGISTRY (utils/registry.py) — the per-run manifests are the source
+    of truth and the registry copies them verbatim, so the rendered
+    numbers match the run manifests bit-exactly.  Skipped cells carry
+    their composition-rejection reason; a done cell with no registry
+    entry (an unjournaled sweep) falls back to the campaign manifest's
+    own copy, flagged in ``problems``.
+
+    Returns {rows, cols, cells, problems}: ``cells`` maps
+    ``"defense|attack"`` to the list of cell records in that bucket
+    (one per cell — seed/epochs axes stack multiple records per
+    bucket)."""
+    rows, cols, cells, problems = [], [], {}, []
+    for cid, row in (manifest.get("cells") or {}).items():
+        d = str(row.get("defense", "?"))
+        a = str(row.get("attack", "auto"))
+        if d not in rows:
+            rows.append(d)
+        if a not in cols:
+            cols.append(a)
+        rec = {"cell": cid, "state": row.get("state")}
+        if row.get("state") == "done":
+            src = None
+            if registry_entries is not None:
+                src = registry_entries.get(cid)
+            if src is not None:
+                rec["source"] = "registry"
+            else:
+                src, rec["source"] = row, "manifest"
+                if registry_entries is not None:
+                    problems.append(
+                        f"{cid}: no registry entry (unjournaled "
+                        f"cell?); values from the campaign manifest")
+            for k in ("final_accuracy", "max_accuracy", "final_asr"):
+                if src.get(k) is not None:
+                    rec[k] = src[k]
+        else:
+            rec["reason"] = row.get("reason")
+        cells.setdefault(f"{d}|{a}", []).append(rec)
+    return {"campaign_id": manifest.get("campaign_id"),
+            "status": manifest.get("status"), "rows": rows,
+            "cols": cols, "cells": cells, "problems": problems}
+
+
+def _campaign_cell_text(recs) -> str:
+    parts = []
+    for rec in recs:
+        if rec["state"] == "done":
+            txt = (f"{rec['final_accuracy']:.2f}"
+                   if rec.get("final_accuracy") is not None else "done")
+            if rec.get("final_asr") is not None:
+                txt += f"/asr {rec['final_asr']:.2f}"
+        elif rec["state"] == "skipped":
+            txt = "skip"
+        elif rec["state"] == "pending":
+            txt = "pending"
+        else:
+            txt = rec["state"].upper()
+        parts.append(txt)
+    return " ; ".join(parts) if parts else "-"
+
+
+def _print_campaign_table(table, out=print):
+    out(f"== campaign {table['campaign_id']}  "
+        f"[{table['status']}] ==")
+    width = max([len(r) for r in table["rows"]] + [7])
+    cw = {a: max(len(a), 12) for a in table["cols"]}
+    out("  " + " " * width + "  "
+        + "  ".join(f"{a:>{cw[a]}s}" for a in table["cols"]))
+    for d in table["rows"]:
+        line = f"  {d:<{width}s}  "
+        line += "  ".join(
+            f"{_campaign_cell_text(table['cells'].get(f'{d}|{a}', [])):>{cw[a]}s}"
+            for a in table["cols"])
+        out(line)
+    skips = [(key, rec) for key, recs in table["cells"].items()
+             for rec in recs if rec["state"] == "skipped"]
+    if skips:
+        out("  skipped cells:")
+        for key, rec in skips:
+            out(f"    {key}: {rec.get('reason')}")
+    fails = [(key, rec) for key, recs in table["cells"].items()
+             for rec in recs if rec["state"] == "failed"]
+    if fails:
+        out("  failed cells:")
+        for key, rec in fails:
+            out(f"    {key}: {rec.get('reason')}")
+    for prob in table["problems"]:
+        out(f"  WARNING: {prob}")
+
+
 def _print_forensics(fx, out, indent="  "):
     """Human-readable forensics table (shared by the per-run summary
     and the 'report forensics' subcommand)."""

@@ -24,11 +24,21 @@ the experiment flag surface stays reference-verbatim).  Verbs:
 - ``runs forensics Q``  — tier-2 selection forensics + the colluder-
   localization verdict over a hierarchical run's schema-v6
   shard_selection stream (report.py:forensics_summary)
+- ``runs campaign [Q]`` — list campaigns, or render one campaign's
+  defense x attack table (report.py:campaign_table) with metric values
+  resolved through the registry — the values match the per-run
+  manifests bit-exactly, and skipped cells show their composition-
+  rejection reason.  Refreshes the registry first (campaign cells
+  finish out-of-band, so a cold index would lie)
 - ``runs selfcheck``    — CI leg: refresh idempotence + resolvability
   over the current run store (tools/smoke.sh leg 6)
 
 Resolution (utils/registry.py): exact run_id, unique prefix, tag, with
 ``key=value`` filters narrowing first.  Pure log/JSON reading — no jax.
+Stale-index guard: verbs that read without refreshing warn LOUDLY when
+``runs/index.jsonl`` is older than the newest run manifest/journal
+(utils/registry.py:stale_run_ids) instead of silently reporting
+outdated summaries.
 """
 
 from __future__ import annotations
@@ -221,6 +231,20 @@ def _refresh(reg, args):
     return summary
 
 
+def _warn_if_stale(reg):
+    """The stale-index footgun: reading without refresh must be LOUD
+    when the store moved under the index (utils/registry.py)."""
+    stale = reg.stale_run_ids()
+    if stale:
+        show = ", ".join(str(s) for s in stale[:4])
+        more = f" (+{len(stale) - 4} more)" if len(stale) > 4 else ""
+        print(f"[registry] WARNING: {reg.index_path} is older than "
+              f"{len(stale)} run journal(s)/manifest(s): {show}{more} "
+              f"— summaries below may be stale; drop --no-refresh or "
+              f"run 'runs list' to rebuild")
+    return stale
+
+
 def cmd_list(reg, args):
     if not args.no_refresh:
         s = _refresh(reg, args)
@@ -228,6 +252,8 @@ def cmd_list(reg, args):
               f"({s['built']} rebuilt, {s['reused']} reused"
               + (f", {s['migrated']} checkpoint(s) migrated"
                  if s.get("migrated") else "") + ")")
+    else:
+        _warn_if_stale(reg)
     ents = reg.entries(args.filter)
     if args.json:
         print(json.dumps(ents, default=str))
@@ -377,6 +403,67 @@ def cmd_async(reg, args):
     return 0
 
 
+def cmd_campaign(reg, args):
+    """List campaigns, or render one campaign's defense x attack table
+    from the registry (report.py:campaign_table).  The registry is
+    refreshed first unless --no-refresh — campaign cells finish in
+    child processes, so a cold index would render stale numbers (and
+    with --no-refresh the staleness guard warns loudly instead)."""
+    from attacking_federate_learning_tpu.report import (
+        _print_campaign_table, campaign_table
+    )
+
+    camp_root = os.path.join(args.run_dir, "campaigns")
+    try:
+        names = sorted(
+            n for n in os.listdir(camp_root)
+            if os.path.exists(os.path.join(camp_root, n,
+                                           "manifest.json")))
+    except OSError:
+        names = []
+    if args.query is None:
+        if not names:
+            print(f"no campaigns under {camp_root} (run one with "
+                  f"'campaign spec.json' or 'grid --journal')")
+            return 0
+        for n in names:
+            with open(os.path.join(camp_root, n, "manifest.json")) as f:
+                man = json.load(f)
+            counts = "  ".join(
+                f"{k}={v}" for k, v in sorted(
+                    (man.get("counts") or {}).items()))
+            print(f"{n}  [{man.get('status', '?')}]  "
+                  f"order={man.get('order')}  {counts}")
+        return 0
+    matches = ([args.query] if args.query in names
+               else [n for n in names if n.startswith(args.query)])
+    if len(matches) != 1:
+        print(f"campaign {args.query!r} "
+              + (f"is ambiguous: {matches}" if matches
+                 else f"not found under {camp_root} "
+                      f"({len(names)} campaigns)"))
+        return 2
+    with open(os.path.join(camp_root, matches[0],
+                           "manifest.json")) as f:
+        man = json.load(f)
+    if args.no_refresh:
+        _warn_if_stale(reg)
+    else:
+        _refresh(reg, args)
+    entries = {str(e.get("run_id")): e for e in reg.entries()}
+    table = campaign_table(man, entries)
+    if args.json:
+        print(json.dumps({"manifest": man, "table": table},
+                         default=str))
+        return 0
+    _print_campaign_table(table)
+    counts = man.get("counts") or {}
+    print("  cells: " + "  ".join(f"{k}={v}" for k, v in
+                                  sorted(counts.items()))
+          + f"   cache: {man.get('cache')}")
+    return 0
+
+
 def cmd_selfcheck(reg, args):
     """CI self-check (tools/smoke.sh leg 6): two refreshes must agree
     (incremental refresh is idempotent over an unchanged store), every
@@ -482,6 +569,16 @@ def main(argv=None) -> int:
                              "async_summary)")
     sp.add_argument("query")
     sp.set_defaults(fn=cmd_async)
+    sp = sub.add_parser("campaign",
+                        help="list campaigns, or render one campaign's "
+                             "defense x attack table from the registry "
+                             "(campaigns/, report.py:campaign_table)")
+    sp.add_argument("query", nargs="?", default=None,
+                    help="campaign id or unique prefix (omit to list)")
+    sp.add_argument("--no-refresh", action="store_true",
+                    help="skip the registry refresh (the staleness "
+                         "guard warns loudly if the store moved)")
+    sp.set_defaults(fn=cmd_campaign)
     sp = sub.add_parser("selfcheck",
                         help="CI: refresh idempotence + resolvability")
     sp.set_defaults(fn=cmd_selfcheck)

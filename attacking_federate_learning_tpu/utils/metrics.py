@@ -43,7 +43,13 @@ one asynchronous-round record per round under
 ``aggregation='async'`` (core/async_rounds.py: delivered / pending /
 in-flight counts, evictions, supersessions, the delivered staleness
 histogram and the weight mass per staleness bucket — emitted with or
-without --telemetry, like 'fault').
+without --telemetry, like 'fault'); v8 adds ``campaign`` — one
+campaign-scheduler transition per record
+(attacking_federate_learning_tpu/campaigns/: campaign start/done,
+cell start and the cell's terminal verdict done/failed/skipped/
+adopted, deadline checkpoints — written to the campaign's own
+``runs/campaigns/<id>/events.jsonl``, never into a run's log by the
+engine).
 Readers accept every version; older logs simply never carry the newer
 kinds, and a newer-only kind stamped with an older version is an
 emitter bug, rejected (``KIND_MIN_VERSION``).
@@ -61,8 +67,8 @@ from typing import Optional
 import numpy as np
 
 
-SCHEMA_VERSION = 7
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
+SCHEMA_VERSION = 8
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)
 
 # kind -> required fields.  Producers: core/engine.py (round, eval, asr,
 # profile, stream, defense, attack, selection_hist via RunLogger).
@@ -147,6 +153,12 @@ EVENT_KINDS = {
     # delivered staleness histogram and the per-bucket weight mass —
     # the staleness-rollup raw material ('report' staleness table)
     "async": {"round", "delivered"},
+    # --- v8: the campaign scheduler (campaigns/scheduler.py) ------------
+    # one scheduler transition: 'phase' is campaign_start/cell_start/
+    # cell_done/cell_failed/cell_skipped/deadline/campaign_done, with
+    # the cell id, rejection reason, cache hit/miss evidence and
+    # summary metrics riding along as diagnostics
+    "campaign": {"campaign", "phase"},
 }
 
 # Minimum schema version per kind introduced after v1; an event carrying
@@ -155,7 +167,7 @@ EVENT_KINDS = {
 KIND_MIN_VERSION = {"compile": 2, "cost": 2, "heartbeat": 2,
                     "lifecycle": 3, "registry": 4, "gate": 4,
                     "secagg": 5, "shard_selection": 6, "forensics": 6,
-                    "async": 7}
+                    "async": 7, "campaign": 8}
 
 # Back-compat alias (pre-v3 spelling used by external readers).
 V2_KINDS = {k for k, v in KIND_MIN_VERSION.items() if v == 2}

@@ -364,6 +364,29 @@ class RunRegistry:
                 "dropped": len(set(old) - set(fresh)),
                 "migrated": self._migrations}
 
+    # --- staleness --------------------------------------------------------
+    def stale_run_ids(self) -> list:
+        """Run ids whose manifest/journal changed AFTER the index was
+        last written — the stale-index footgun: a reader that skips
+        refresh() ('runs list --no-refresh', a cold 'runs campaign')
+        would silently report outdated summaries.  Returns every run
+        dir when the index does not exist yet."""
+        try:
+            idx_mtime = os.path.getmtime(self.index_path)
+        except OSError:
+            return self._run_dirs()
+        stale = []
+        for rid in self._run_dirs():
+            d = os.path.join(self.run_dir, rid)
+            for name in (_MANIFEST, _JOURNAL):
+                try:
+                    if os.path.getmtime(os.path.join(d, name)) > idx_mtime:
+                        stale.append(rid)
+                        break
+                except OSError:
+                    continue
+        return stale
+
     # --- queries ----------------------------------------------------------
     def entries(self, filters=()) -> list:
         """Index entries (stable run_id order), optionally filtered by

@@ -64,6 +64,14 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs",
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: outside the tier-1 budget (tier-1 runs -m 'not slow'); "
+        "e.g. the measured campaign cache-ordering proof, which spawns "
+        "a child process per cell")
+
+
 @pytest.fixture(scope="session")
 def hard_ds():
     """Shared low-SNR behavioral dataset (generated once per session)."""

@@ -160,7 +160,7 @@ def test_async_run_events_match_replay(tmp_path, defense, weighting,
     for e in events:
         validate_event(e)
     assert [e["round"] for e in av] == list(range(cfg.epochs))
-    assert all(e["v"] == 7 for e in av)
+    assert all(e["v"] >= 7 for e in av)   # stamped with the writer version
     rows = A.replay_schedule(cfg, exp.m, exp.m_mal, cfg.epochs)
     for e, r in zip(av, rows):
         assert int(e["delivered"]) == r["delivered"]

@@ -292,6 +292,12 @@ def test_schema_roundtrip_every_engine_kind(tmp_path):
         # real emission path is covered in tests/test_hierarchy.py).
         logger.record(kind="forensics", verdict="localized",
                       isolated_shards=[0])
+        # v8: the campaign-scheduler kind (campaigns/scheduler.py
+        # writes these to its own runs/campaigns/<id>/events.jsonl;
+        # synthesized here — the real emission path is covered in
+        # tests/test_campaign.py).
+        logger.record(kind="campaign", campaign="c_test",
+                      phase="cell_done", cell="x", rc=0)
         # v3: a journaled run emits the 'lifecycle' kind from the
         # engine itself (start/complete; utils/lifecycle.py) — and, as
         # of v4, the run-finish 'registry' stamp.

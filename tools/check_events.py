@@ -18,7 +18,11 @@ protocols/secagg.py — plus v6's hierarchical-forensics kinds:
 hierarchical rounds under --telemetry, core/engine.py, and
 'forensics' colluder-localization verdicts, report.py — plus v7's
 'async' kind: one asynchronous-round record per round under
-aggregation='async', core/async_rounds.py).  An
+aggregation='async', core/async_rounds.py — plus v8's 'campaign'
+kind: one campaign-scheduler transition per record — campaign
+start/done, cell start/done/failed/skipped verdicts and deadline
+checkpoints — written to runs/campaigns/<id>/events.jsonl,
+campaigns/scheduler.py).  An
 event stamped with a
 version this reader does not know is reported as "produced by a newer
 writer" — a clear per-line error, never a KeyError — and a newer-only

@@ -62,34 +62,27 @@ def expected_schedule(cfg, m, m_mal, epochs):
     return rows
 
 
-def run_cell(defense, faults_kw, epochs, users, log_dir):
-    """One fault x defense cell; returns (jsonl_path, cfg, error-or-None)."""
+def matrix_spec(defenses, faults_kw, epochs, users, log_dir):
+    """The fault x defense sweep as a campaign spec (ISSUE 10
+    satellite: the ad-hoc cell loop ported onto campaign cells —
+    campaigns/spec.py; the host-replay event diff stays wired as the
+    per-cell check through the scheduler's ``checks`` hook)."""
     from attacking_federate_learning_tpu import config as C
-    from attacking_federate_learning_tpu.attacks import DriftAttack
-    from attacking_federate_learning_tpu.config import (
-        ExperimentConfig, FaultConfig
+    from attacking_federate_learning_tpu.campaigns.spec import (
+        CampaignSpec
     )
-    from attacking_federate_learning_tpu.core.engine import (
-        FederatedExperiment
-    )
-    from attacking_federate_learning_tpu.data.datasets import load_dataset
-    from attacking_federate_learning_tpu.utils.metrics import RunLogger
 
-    cfg = ExperimentConfig(
-        dataset=C.SYNTH_MNIST, users_count=users,
-        mal_prop=0.2 if users >= 15 else 0.1,
-        batch_size=16, epochs=epochs, test_step=epochs,
-        defense=defense, synth_train=256, synth_test=64,
-        faults=FaultConfig(**faults_kw), log_dir=log_dir)
-    ds = load_dataset(cfg.dataset, seed=0, synth_train=256, synth_test=64)
-    exp = FederatedExperiment(cfg, attacker=DriftAttack(1.0), dataset=ds)
-    name = f"fault_matrix_{defense}"
-    try:
-        with RunLogger(cfg, None, log_dir, jsonl_name=name) as logger:
-            exp.run(logger)
-    except Exception as e:                        # noqa: BLE001
-        return os.path.join(log_dir, name + ".jsonl"), cfg, f"raised: {e}"
-    return os.path.join(log_dir, name + ".jsonl"), cfg, None
+    return CampaignSpec(
+        name="fault_matrix",
+        base=dict(dataset=C.SYNTH_MNIST, users_count=users,
+                  mal_prop=0.2 if users >= 15 else 0.1,
+                  num_std=1.0,            # the historical DriftAttack z
+                  batch_size=16, epochs=epochs, test_step=epochs,
+                  synth_train=256, synth_test=64,
+                  faults=dict(faults_kw), log_dir=log_dir,
+                  attack="alie"),
+        axes={"defense": list(defenses)},
+        order="spec")
 
 
 def check_cell(path, cfg, epochs):
@@ -235,21 +228,37 @@ def main(argv=None) -> int:
     log_dir = args.log_dir or tempfile.mkdtemp(prefix="fault_matrix_")
     faults_kw = dict(dropout=args.dropout, straggler=args.straggler,
                      corrupt=args.corrupt)
-    failed = False
-    for defense in args.defenses.split(","):
-        defense = defense.strip()
-        path, cfg, err = run_cell(defense, faults_kw, args.epochs,
-                                  args.users, log_dir)
-        errors = ([err] if err else []) + (
-            [] if err else check_cell(path, cfg, args.epochs))
-        if errors:
-            failed = True
-            print(f"FAIL {defense}: {len(errors)} problem(s)")
-            for e in errors[:10]:
-                print(f"  {e}")
-        else:
+    defenses = [d.strip() for d in args.defenses.split(",")]
+    spec = matrix_spec(defenses, faults_kw, args.epochs, args.users,
+                       log_dir)
+
+    from attacking_federate_learning_tpu.campaigns.scheduler import (
+        Campaign
+    )
+
+    def checks(cell, result):
+        # The host-replay event diff, per cell: a 'done' run whose
+        # emitted fault counts drift from the schedule FAILS the cell.
+        return check_cell(result["events"], cell.cfg, args.epochs)
+
+    rows = []
+
+    def on_cell(cell, row):
+        rows.append((cell, row))
+
+    rc = Campaign(spec, executor="inline", journal_runs=False,
+                  persist=False, checks=checks, on_cell=on_cell).run()
+    # A skipped cell means the caller named a defense the fault model
+    # cannot run — an error here (the default set is mask-aware only).
+    failed = rc != 0 or any(row["state"] != "done" for _, row in rows)
+    for cell, row in rows:
+        defense = cell.cfg.defense if cell.cfg else "?"
+        if row["state"] == "done":
             print(f"ok   {defense}: {args.epochs} rounds, fault events "
-                  f"match the injected schedule  ({path})")
+                  f"match the injected schedule  ({row.get('events')})")
+        else:
+            print(f"FAIL {defense} ({row['state']}): "
+                  f"{row.get('reason')}")
     if not args.no_async:
         errors = run_async_cell("Krum", args.epochs, args.users,
                                 log_dir, dropout=args.dropout)

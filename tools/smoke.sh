@@ -3,17 +3,17 @@
 # matrix + perf gate (incl. hierarchical memproof + secagg wireproof) +
 # science gate + registry selfcheck + hierarchical-aggregation smoke +
 # secure-aggregation smoke + hierarchical-telemetry/forensics smoke +
-# asynchronous-rounds smoke.
+# asynchronous-rounds smoke + campaign-engine kill/resume smoke.
 #
-#   bash tools/smoke.sh            # all ten, CPU-pinned
+#   bash tools/smoke.sh            # all eleven, CPU-pinned
 #   bash tools/smoke.sh --fast     # skip the fault + crash matrices
 #                                  # (the two slowest legs)
 #
 # Legs (each independently CI-wired through tests/ as well):
 #   1. tools/check_events.py over every run JSONL in logs/ (schema
-#      v1-v7: round/eval/.../fault, compile/cost/heartbeat, lifecycle,
-#      registry/gate, secagg, shard_selection/forensics, async) —
-#      skipped when logs/ has no .jsonl yet;
+#      v1-v8: round/eval/.../fault, compile/cost/heartbeat, lifecycle,
+#      registry/gate, secagg, shard_selection/forensics, async,
+#      campaign) — skipped when logs/ has no .jsonl yet;
 #   2. tools/fault_matrix.py — 5-round fault x defense sweep, emitted
 #      'fault' events diffed against the host replay of the schedule,
 #      plus the dropout x async-buffer leg (async + fault events
@@ -50,7 +50,15 @@
 #      buffered rounds, core/async_rounds.py), then RunJournal.verify
 #      (every round and eval exactly once), check_events over the
 #      private logs (v7 'async' events), and an async-event audit:
-#      one per round, every delivered round exactly k rows.
+#      one per round, every delivered round exactly k rows;
+#  11. campaign-engine smoke — a journaled 2x2 (defense x attack)
+#      campaign on SYNTH_MNIST (campaigns/scheduler.py) with one
+#      injected mid-campaign kill (FL_CAMPAIGN_KILL_AFTER_CELLS) +
+#      resume: the re-invoke completes only the remaining cells, the
+#      campaign journal audits exactly-once, runs/index.jsonl carries
+#      zero duplicate run stamps, check_events validates the v8
+#      'campaign' event stream, and 'runs campaign <id>' renders the
+#      defense x attack table from the registry.
 #
 # Exit: nonzero if any leg fails.  Always CPU (the gates' baselines are
 # CPU artifacts, and the matrices must not touch a TPU capture).
@@ -65,32 +73,32 @@ fail=0
 shopt -s nullglob
 jsonls=(logs/*.jsonl)
 if [ ${#jsonls[@]} -gt 0 ]; then
-    echo "== smoke 1/10: check_events (${#jsonls[@]} logs) =="
+    echo "== smoke 1/11: check_events (${#jsonls[@]} logs) =="
     python tools/check_events.py "${jsonls[@]}" || fail=1
 else
-    echo "== smoke 1/10: check_events — no logs/*.jsonl yet, skipped =="
+    echo "== smoke 1/11: check_events — no logs/*.jsonl yet, skipped =="
 fi
 
 crash_work=""
 if [ "${1:-}" != "--fast" ]; then
-    echo "== smoke 2/10: fault_matrix =="
+    echo "== smoke 2/11: fault_matrix =="
     python tools/fault_matrix.py || fail=1
-    echo "== smoke 3/10: crash_matrix (supervised preempt/resume) =="
+    echo "== smoke 3/11: crash_matrix (supervised preempt/resume) =="
     # Keep the matrix's run stores: leg 6 registry-checks them.
     crash_work="$(mktemp -d -t crash_matrix_XXXXXX)"
     python tools/crash_matrix.py --workdir "$crash_work" || fail=1
 else
-    echo "== smoke 2/10: fault_matrix — skipped (--fast) =="
-    echo "== smoke 3/10: crash_matrix — skipped (--fast) =="
+    echo "== smoke 2/11: fault_matrix — skipped (--fast) =="
+    echo "== smoke 3/11: crash_matrix — skipped (--fast) =="
 fi
 
-echo "== smoke 4/10: perf_gate (+ hierarchical memproof) =="
+echo "== smoke 4/11: perf_gate (+ hierarchical memproof) =="
 python tools/perf_gate.py --memproof || fail=1
 
-echo "== smoke 5/10: science_gate (behavioral drift) =="
+echo "== smoke 5/11: science_gate (behavioral drift) =="
 python tools/science_gate.py || fail=1
 
-echo "== smoke 6/10: runs selfcheck (registry) =="
+echo "== smoke 6/11: runs selfcheck (registry) =="
 python -m attacking_federate_learning_tpu.cli runs selfcheck || fail=1
 if [ -n "$crash_work" ]; then
     # The registry over the crash matrix's preempt/resume artifacts:
@@ -107,7 +115,7 @@ if [ -n "$crash_work" ]; then
     rm -rf "$crash_work"
 fi
 
-echo "== smoke 7/10: hierarchical aggregation (journaled, audited) =="
+echo "== smoke 7/11: hierarchical aggregation (journaled, audited) =="
 hier_work="$(mktemp -d -t hier_smoke_XXXXXX)"
 for def in Krum TrimmedMean; do
     python -m attacking_federate_learning_tpu.cli \
@@ -133,7 +141,7 @@ sys.exit(bad)
 PY
 rm -rf "$hier_work"
 
-echo "== smoke 8/10: secure aggregation (journaled, audited) =="
+echo "== smoke 8/11: secure aggregation (journaled, audited) =="
 sa_work="$(mktemp -d -t secagg_smoke_XXXXXX)"
 # vanilla: one dropout-rate high enough that the 5-round seeded run is
 # guaranteed (and pinned by the audit below) to include at least one
@@ -182,7 +190,7 @@ sys.exit(bad)
 PY
 rm -rf "$sa_work"
 
-echo "== smoke 9/10: hierarchical telemetry + forensics (journaled) =="
+echo "== smoke 9/11: hierarchical telemetry + forensics (journaled) =="
 fx_work="$(mktemp -d -t hier_tele_smoke_XXXXXX)"
 # 5-round journaled hierarchical x Krum run with --telemetry: the run
 # must emit one schema-v6 'shard_selection' event per round.
@@ -219,7 +227,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     trace hier_tele_smoke -o "$fx_work/trace.json" || fail=1
 rm -rf "$fx_work"
 
-echo "== smoke 10/10: asynchronous rounds (journaled, audited) =="
+echo "== smoke 10/11: asynchronous rounds (journaled, audited) =="
 as_work="$(mktemp -d -t async_smoke_XXXXXX)"
 # 5-round journaled FedBuff runs: k=8 of n=12 aggregated per applied
 # round, staleness bound 2, poly weighting, Krum + TrimmedMean.
@@ -251,8 +259,8 @@ for rid in ("async_Krum_smoke", "async_TrimmedMean_smoke"):
     av = [e for e in events if e.get("kind") == "async"]
     if len(av) != 5:
         problems.append(f"{len(av)} async events, want one per round")
-    if any(e.get("v") != 7 for e in av):
-        problems.append("async event not stamped v7")
+    if any(e.get("v", 0) < 7 for e in av):
+        problems.append("async event stamped below v7")
     if any(int(e.get("delivered", -1)) not in (0, 8) for e in av):
         problems.append("a delivered round did not aggregate "
                         "exactly k=8 rows")
@@ -268,6 +276,58 @@ python -m attacking_federate_learning_tpu.cli runs \
     --run-dir "$as_work/runs" --bench '' --progress '' \
     async async_Krum_smoke || fail=1
 rm -rf "$as_work"
+
+echo "== smoke 11/11: campaign engine (kill + resume, audited) =="
+ce_work="$(mktemp -d -t campaign_smoke_XXXXXX)"
+cat > "$ce_work/spec.json" <<SPEC
+{"name": "smoke",
+ "base": {"dataset": "SYNTH_MNIST", "users_count": 12, "mal_prop": 0.25,
+          "batch_size": 16, "epochs": 5, "synth_train": 256,
+          "synth_test": 64, "backend": "cpu",
+          "log_dir": "$ce_work/logs", "run_dir": "$ce_work/runs"},
+ "axes": {"defense": ["Krum", "TrimmedMean"],
+          "attack": ["none", "alie"]}}
+SPEC
+# First invocation dies (injected SIGKILL-equivalent) after 2 cells...
+FL_CAMPAIGN_KILL_AFTER_CELLS=2 \
+python -m attacking_federate_learning_tpu.campaigns "$ce_work/spec.json" \
+    --executor inline > /dev/null 2>&1
+rc=$?
+[ "$rc" -eq 137 ] || { echo "FAIL campaign: expected kill rc 137, got $rc"; fail=1; }
+# ...the re-invoke completes only the remaining cells.
+python -m attacking_federate_learning_tpu.campaigns "$ce_work/spec.json" \
+    --executor inline || fail=1
+camp_id="$(ls "$ce_work/runs/campaigns")"
+# Exactly-once audits: campaign journal + zero duplicate run stamps.
+python - "$ce_work" "$camp_id" <<'PY' || fail=1
+import json, os, sys
+from attacking_federate_learning_tpu.campaigns import CampaignJournal
+work, camp_id = sys.argv[1], sys.argv[2]
+j = CampaignJournal(os.path.join(work, "runs"), camp_id)
+problems = j.verify()
+man = j.read_manifest()
+if man["status"] != "done" or man["counts"].get("done") != 4:
+    problems.append(f"campaign not done: {man['status']} {man['counts']}")
+attempts = [r for r in j.records() if r.get("kind") == "attempt"]
+if len(attempts) != 2:
+    problems.append(f"{len(attempts)} attempts recorded, want 2")
+ids = [json.loads(line)["run_id"]
+       for line in open(os.path.join(work, "runs", "index.jsonl"))]
+if len(ids) != len(set(ids)):
+    problems.append(f"duplicate run stamps in index.jsonl: {ids}")
+print("  campaign journal: " + ("ok (exactly-once, resumed)"
+                                if not problems else f"FAIL {problems}"))
+sys.exit(bool(problems))
+PY
+# The v8 'campaign' event stream validates...
+python tools/check_events.py \
+    "$ce_work/runs/campaigns/$camp_id/events.jsonl" || fail=1
+# ...and 'runs campaign <id>' renders the defense x attack table from
+# the registry (values bit-exact against the per-run manifests).
+python -m attacking_federate_learning_tpu.cli runs \
+    --run-dir "$ce_work/runs" --bench '' --progress '' \
+    campaign "$camp_id" || fail=1
+rm -rf "$ce_work"
 
 if [ $fail -ne 0 ]; then
     echo "SMOKE FAILED"

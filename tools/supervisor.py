@@ -71,6 +71,27 @@ STDERR_TAIL_BYTES = 8192
 MAX_PREEMPT_RESUMES = 100   # safety backstop, not a budget: preempts are
 #                             externally caused and individually cheap
 
+# Defaults for every supervisor option (the argparse surface below and
+# build_opts share these, so programmatic callers can't drift).
+OPTION_DEFAULTS = dict(raw=False, max_retries=3, backoff_base=2.0,
+                       backoff_max=60.0, checkpoint_every=5,
+                       stall_timeout=0.0, stall_grace=30.0,
+                       poll_interval=1.0, run_id=None, events=None,
+                       verify_journal=False, inject_preempt_round=None,
+                       child_env=None)
+
+
+def build_opts(**overrides):
+    """Options namespace for programmatic supervision (the campaign
+    scheduler drives Supervisor objects directly; campaigns/
+    scheduler.py).  ``child_env`` is a dict of environment overrides
+    merged into every child attempt — the campaign pins its
+    persistent-cache dir there."""
+    unknown = set(overrides) - set(OPTION_DEFAULTS)
+    if unknown:
+        raise ValueError(f"unknown supervisor options {sorted(unknown)}")
+    return argparse.Namespace(**{**OPTION_DEFAULTS, **overrides})
+
 
 class Supervisor:
     def __init__(self, opts, child_args):
@@ -240,6 +261,7 @@ class Supervisor:
             prefix="supervisor_stderr_", suffix=".log", delete=False)
         started = time.time()
         env = dict(os.environ)
+        env.update(getattr(self.opts, "child_env", None) or {})
         if self.opts.inject_preempt_round is not None:
             env["FL_PREEMPT_AT_ROUND"] = str(self.opts.inject_preempt_round)
         proc = subprocess.Popen(cmd, stderr=stderr_f, env=env)
