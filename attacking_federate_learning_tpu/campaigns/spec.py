@@ -205,10 +205,14 @@ def validate_composition(cfg: ExperimentConfig,
                 f"scanned program")
         for knob in ("trimmed_mean_impl", "median_impl",
                      "bulyan_selection_impl", "bulyan_trim_impl"):
-            if getattr(cfg, knob) != "xla":
+            if getattr(cfg, knob) == "host":
+                # Mirrors engine._init_hierarchical: the pallas values
+                # stay inside the scanned program and compose; only
+                # the host kernels would pay a per-megabatch callback.
                 raise ValueError(
-                    f"hierarchical aggregation requires {knob}='xla' "
-                    f"(host kernels would pure_callback once per "
+                    f"hierarchical aggregation requires a device-"
+                    f"resident {knob} ('xla' or 'pallas'; got 'host' — "
+                    f"a host kernel would pure_callback once per "
                     f"megabatch per scan step)")
         S = cfg.users_count // cfg.megabatch
         f = cfg.corrupted_count
@@ -275,8 +279,12 @@ class Cell:
         out = {"cell": self.cell_id, "attack": self.attack,
                "priority": self.priority, "group": self.group,
                "index": self.index}
+        # The impl knobs ride along so `runs campaign` can render
+        # impl-comparison tables (xla vs pallas vs host sweeps,
+        # ISSUE 11) straight from the journal rows.
         for k in ("dataset", "defense", "seed", "epochs", "aggregation",
-                  "secagg"):
+                  "secagg", "aggregation_impl", "distance_impl",
+                  "bulyan_selection_impl"):
             if self.cfg is not None:
                 out[k] = getattr(self.cfg, k)
             elif k in self.overrides:
@@ -422,7 +430,9 @@ _VALUE_FLAGS = (
     ("bulyan_batch_select", "--bulyan-batch-select"),
     ("bulyan_selection_impl", "--bulyan-selection-impl"),
     ("bulyan_trim_impl", "--bulyan-trim-impl"),
-    ("aggregation", "--aggregation"), ("async_buffer", "--async-buffer"),
+    ("aggregation", "--aggregation"),
+    ("aggregation_impl", "--aggregation-impl"),
+    ("async_buffer", "--async-buffer"),
     ("async_max_staleness", "--async-max-staleness"),
     ("staleness_weight", "--staleness-weight"),
     ("megabatch", "--megabatch"), ("mal_placement", "--mal-placement"),

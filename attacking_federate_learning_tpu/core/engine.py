@@ -167,15 +167,18 @@ class FederatedExperiment:
         self.defense_fn = DEFENSES[cfg.defense]
         if cfg.defense in ("Krum", "Bulyan"):
             self.defense_fn = self._wire_distance_defense(self.defense_fn)
-        elif (cfg.defense == "TrimmedMean"
-                and cfg.trimmed_mean_impl != "xla"):
-            # Opt-in native host kernel (defenses/kernels.py:trimmed_mean
-            # explains why this is not auto-dispatched).
-            self.defense_fn = functools.partial(
-                self.defense_fn, impl=cfg.trimmed_mean_impl)
-        elif cfg.defense == "Median" and cfg.median_impl != "xla":
-            self.defense_fn = functools.partial(
-                self.defense_fn, impl=cfg.median_impl)
+        elif cfg.defense in ("TrimmedMean", "Median"):
+            # Opt-in kernel routing (defenses/kernels.py:trimmed_mean
+            # explains why the host kernel is not auto-dispatched; the
+            # pallas suite is the same opt-in standard, ISSUE 11 —
+            # config validation keeps the two exclusive).
+            impl = (cfg.trimmed_mean_impl if cfg.defense == "TrimmedMean"
+                    else cfg.median_impl)
+            if cfg.aggregation_impl == "pallas":
+                impl = "pallas"
+            if impl != "xla":
+                self.defense_fn = functools.partial(
+                    self.defense_fn, impl=impl)
         elif cfg.defense == "DnC":
             # DnC's constants are config surface (the most constant-
             # sensitive defense), and its sketch keys flow from the
@@ -361,10 +364,14 @@ class FederatedExperiment:
                 f"scanned program")
         for knob in ("trimmed_mean_impl", "median_impl",
                      "bulyan_selection_impl", "bulyan_trim_impl"):
-            if getattr(cfg, knob) != "xla":
+            if getattr(cfg, knob) == "host":
+                # The pallas values stay INSIDE the scanned program
+                # (ISSUE 11) and compose; only the host kernels would
+                # pure_callback once per megabatch per scan step.
                 raise ValueError(
-                    f"hierarchical aggregation requires {knob}='xla' "
-                    f"(host kernels would pure_callback once per "
+                    f"hierarchical aggregation requires a device-"
+                    f"resident {knob} ('xla' or 'pallas'; got 'host' — "
+                    f"a host kernel would pure_callback once per "
                     f"megabatch per scan step)")
 
         self._placement = make_placement(self.n, self.f, cfg.megabatch,
@@ -460,20 +467,32 @@ class FederatedExperiment:
         )
 
         cfg = self.cfg
+        pallas_suite = cfg.aggregation_impl == "pallas"
         kw = {"method": cfg.krum_scoring_method}
         if cfg.krum_paper_scoring:
             kw["paper_scoring"] = True
         if cfg.distance_dtype != "float32":
             kw["distance_dtype"] = cfg.distance_dtype
+        if cfg.defense == "Krum" and pallas_suite:
+            # The fused distance->score kernel (ops/pallas_defense.py):
+            # scores in one sweep, no (n, n) matrix, the topk-class
+            # cancellation guard applied inside the dispatch.
+            kw["scores_impl"] = "pallas"
         if cfg.defense == "Bulyan":
             if cfg.bulyan_batch_select != 1:
                 kw["batch_select"] = cfg.bulyan_batch_select
-            if cfg.bulyan_selection_impl != "xla":
-                # Hybrid exact selection: device distances, one (n, n)
-                # D marshal, native host selection, device trim-mean.
-                kw["selection_impl"] = cfg.bulyan_selection_impl
-            if cfg.bulyan_trim_impl != "xla":
-                kw["trim_impl"] = cfg.bulyan_trim_impl
+            sel = cfg.bulyan_selection_impl
+            if pallas_suite and sel == "xla":
+                sel = "pallas"
+            if sel != "xla":
+                # 'host': hybrid exact selection — device distances, one
+                # (n, n) D marshal, native host selection, device
+                # trim-mean.  'pallas': the all-on-device exact route —
+                # pallas D, traced selection loop, no marshal.
+                kw["selection_impl"] = sel
+            trim = "pallas" if pallas_suite else cfg.bulyan_trim_impl
+            if trim != "xla":
+                kw["trim_impl"] = trim
         impl = cfg.distance_impl
         if impl in ("ring", "allgather"):
             if self.shardings is None:
@@ -964,6 +983,16 @@ class FederatedExperiment:
                 self._fault_span = jax.jit(fault_span, static_argnums=2)
             self._staged = False
         else:
+            if (cfg.aggregation_impl == "pallas"
+                    or cfg.bulyan_selection_impl == "pallas"):
+                # Config already rejects --backdoor-staged ⊕ pallas;
+                # this catches a non-fusable attacker handed in
+                # programmatically (same seam as the secagg check).
+                raise ValueError(
+                    "the staged (host-eager) aggregation path does not "
+                    "run the Pallas defense suite "
+                    "(aggregation_impl/bulyan_selection_impl='pallas' "
+                    "need a fusable attack)")
             self._compute_grads = jax.jit(self._compute_grads_impl)
             # Staged rounds already cross the host boundary every round,
             # so on the CPU backend a Krum/Bulyan aggregation runs EAGERLY:

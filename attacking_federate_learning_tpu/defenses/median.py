@@ -19,7 +19,10 @@ def median(users_grads, users_count, corrupted_count, impl="xla",
     """``impl='host'`` (opt-in, config ``median_impl``) routes to the
     native column-blocked kernel (native/bulyan_select.cpp:fl_median) —
     same rationale and same non-auto-dispatch rule as
-    kernels.py:trimmed_mean.
+    kernels.py:trimmed_mean.  ``impl='pallas'`` (config
+    ``aggregation_impl='pallas'``) is the on-device tiled kernel
+    (ops/pallas_defense.py) — the masked/weighted variants replicate
+    kernels.masked_median bit for bit (pinned, tests/test_pallas.py).
 
     ``telemetry=True`` additionally returns ``{'dist_to_agg': (n,)}`` —
     each client's L2 distance to the aggregated median vector, the
@@ -42,10 +45,17 @@ def median(users_grads, users_count, corrupted_count, impl="xla",
             raise ValueError(
                 "mask-aware Median has no host kernel "
                 "(defenses/host.py is maskless); use impl='xla'")
-        from attacking_federate_learning_tpu.defenses.kernels import (
-            masked_median
-        )
-        agg = masked_median(users_grads, mask, weights=weights)
+        if impl == "pallas":
+            from attacking_federate_learning_tpu.ops.pallas_defense import (
+                pallas_masked_median
+            )
+            agg = pallas_masked_median(users_grads, mask, weights=weights,
+                                       weighted=weights is not None)
+        else:
+            from attacking_federate_learning_tpu.defenses.kernels import (
+                masked_median
+            )
+            agg = masked_median(users_grads, mask, weights=weights)
         if not telemetry:
             return agg
         G = users_grads.astype(jnp.float32)
@@ -60,6 +70,11 @@ def median(users_grads, users_count, corrupted_count, impl="xla",
             host_coordwise
         )
         agg = host_coordwise(host_median, users_grads)
+    elif impl == "pallas":
+        from attacking_federate_learning_tpu.ops.pallas_defense import (
+            pallas_median_of
+        )
+        agg = pallas_median_of(users_grads)
     else:
         agg = jnp.median(users_grads, axis=0)
     if not telemetry:

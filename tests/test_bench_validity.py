@@ -151,3 +151,74 @@ def test_host_cache_fingerprint_keys_the_cache_dir():
 
     assert jax.config.jax_compilation_cache_dir == \
         os.environ["JAX_COMPILATION_CACHE_DIR"]
+
+
+def test_classify_aot_warning_collapses_tuning_only_mismatch():
+    """ISSUE 11 bench-hygiene satellite: the same-host cpu_aot_loader
+    SIGILL false positive (only +prefer-no-scatter/+prefer-no-gather
+    named — CLAUDE.md) collapses to one annotated line; a REAL
+    cross-host mismatch (ISA features named) must pass through."""
+    from attacking_federate_learning_tpu.utils.backend import (
+        classify_aot_warning
+    )
+
+    benign = (
+        "W0000 cpu_aot_loader.cc:55] executable was compiled with: "
+        "[+aes,+avx,+sse4.1,+prefer-no-scatter,+prefer-no-gather,"
+        "-amx-avx512,-fma4] vs host machine features: "
+        "[aes,avx,sse4.1,fma]. This could lead to execution errors "
+        "such as SIGILL.")
+    is_warn, is_benign, note = classify_aot_warning(benign)
+    assert is_warn and is_benign
+    assert "prefer-no-scatter" in note and len(note) < 250
+    assert "collapsed" in note
+
+    real = benign.replace("+prefer-no-scatter,",
+                          "+amx-fp16,+prefer-no-scatter,")
+    is_warn, is_benign, note = classify_aot_warning(real)
+    assert is_warn and not is_benign and note is None
+
+    assert classify_aot_warning("ordinary line")[0] is False
+    # a matching warning whose feature lists can't be parsed stays loud
+    garbled = "foo SIGILL bar host machine features baz"
+    is_warn, is_benign, _ = classify_aot_warning(garbled)
+    assert is_warn and not is_benign
+
+
+def test_aot_warning_collapse_pipe_roundtrip():
+    """fd-level behavior: the benign dump collapses, the real mismatch
+    and ordinary lines pass through, and python-side sys.stderr writes
+    bypass the pump (the recap/deadline escape hatches must never
+    depend on the filter thread)."""
+    import os
+    import subprocess
+    import sys
+
+    code = r"""
+import os, sys, time
+from attacking_federate_learning_tpu.utils.backend import (
+    install_aot_warning_collapse)
+install_aot_warning_collapse()
+benign = ("W cpu_aot_loader] compiled with: [+aes,+prefer-no-scatter,"
+          "+prefer-no-gather,-x] vs host machine features: [aes]. "
+          "This could lead to execution errors such as SIGILL.")
+real = benign.replace("+aes", "+amx-fp16,+aes")
+os.write(2, (benign + "\n").encode())
+os.write(2, (real + "\n").encode())
+os.write(2, b"plain C-side line\n")
+print("python-side line", file=sys.stderr, flush=True)
+time.sleep(0.4)
+"""
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu",
+                               "PALLAS_AXON_POOL_IPS": ""})
+    err = proc.stderr
+    assert proc.returncode == 0, err
+    assert "false positive collapsed" in err
+    # only the real mismatch's full dump survives (the collapsed note
+    # mentions SIGILL too, so count the dump phrase)
+    assert err.count("could lead to execution errors") == 1
+    assert "amx-fp16" in err
+    assert "plain C-side line" in err
+    assert "python-side line" in err

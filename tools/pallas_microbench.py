@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Pallas defense-kernel micro-bench: the capture-window payload.
+
+Compiles each ops/pallas_defense.py kernel on the CURRENT backend — a
+real Mosaic compile when a TPU is live (the first hard evidence the
+kernels lower through Mosaic at all), interpret mode otherwise — and
+times a few executions with the bench.py fetch-bounded methodology.
+One JSON line per kernel on stdout; chatter on stderr, so
+tools/tpu_capture.sh can tee the artifact cleanly.
+
+    python tools/pallas_microbench.py [--n N] [--d D] [--rehearse]
+
+--rehearse: the CPU dress-rehearsal stub (tools/tpu_capture.sh
+--rehearse): tiny shapes, interpret forced on, same steps and the same
+JSON schema — proves the step mechanics without burning a window.
+
+On TPU the fused Krum-score kernel runs the balanced large-tile
+configuration (bm=bn=512, bk=1024: tile HBM traffic ~n²·d·8/512 bytes,
+matching the MXU's f32 roofline at the 10k point) and the parity check
+diffs each kernel against its XLA reference at f32 tolerance — a
+Mosaic numeric fault fails loudly here, inside the window, instead of
+poisoning a later science run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--n", type=int, default=2048)
+    p.add_argument("--d", type=int, default=79_510)
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--rehearse", action="store_true",
+                   help="CPU stub: tiny shapes, interpret forced on")
+    args = p.parse_args(argv)
+
+    from attacking_federate_learning_tpu.utils.backend import (
+        enable_compile_cache, ensure_live_backend,
+        install_aot_warning_collapse
+    )
+
+    install_aot_warning_collapse()
+    if args.rehearse:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    else:
+        ensure_live_backend()
+    enable_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from attacking_federate_learning_tpu.defenses.kernels import (
+        _krum_scores, bulyan, trimmed_mean_of
+    )
+    from attacking_federate_learning_tpu.ops.distances import (
+        pairwise_distances
+    )
+    from attacking_federate_learning_tpu.ops.pallas_defense import (
+        krum_scores_cost, pallas_krum_scores, pallas_median_of,
+        pallas_trimmed_mean_of
+    )
+
+    dev = jax.devices()[0]
+    on_accel = dev.platform not in ("cpu",)
+    interpret = None if on_accel else True
+    if args.rehearse:
+        n, d = 64, 1024
+        interpret = True
+    else:
+        n, d = args.n, args.d
+    f = int(0.24 * n)
+    log(f"pallas_microbench: backend={dev.platform} n={n} d={d} f={f} "
+        f"interpret={interpret}")
+    G = jax.jit(lambda k: jax.random.normal(k, (n, d), jnp.float32))(
+        jax.random.PRNGKey(0))
+    np.asarray(G[:1, :1])    # materialize
+
+    # Large tiles on real hardware (roofline-balanced at 10k); the CI
+    # defaults elsewhere keep small-n interpret coverage cheap.
+    tiles = (dict(bm=512, bn=512, bk=1024) if on_accel
+             else dict(bm=128, bn=128, bk=512))
+
+    def fetch1(out):
+        """1-element corner fetch — the only sync that provably waits
+        through the relay (bench.py methodology); never a full copy."""
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        return np.asarray(leaf[(slice(0, 1),) * leaf.ndim])
+
+    def timed(fn):
+        out = fn()
+        fetch1(out)                                  # compile + warm
+        walls = []
+        for _ in range(max(1, args.repeats)):
+            t0 = time.perf_counter()
+            out = fn()
+            fetch1(out)
+            walls.append(1e3 * (time.perf_counter() - t0))
+        return float(np.median(walls)), out
+
+    k_keep = n - f - 1
+    cells = [
+        ("krum_score_fusion",
+         jax.jit(lambda g: pallas_krum_scores(
+             g, n, f, interpret=interpret, **tiles)[0]),
+         jax.jit(lambda g: _krum_scores(pairwise_distances(g), n, f,
+                                        method="sort")),
+         krum_scores_cost(n, d, f, **tiles)),
+        ("trimmed_mean_tile",
+         jax.jit(lambda g: pallas_trimmed_mean_of(
+             g, k_keep, interpret=interpret)),
+         jax.jit(lambda g: trimmed_mean_of(g, k_keep)), None),
+        ("median_tile",
+         jax.jit(lambda g: pallas_median_of(g, interpret=interpret)),
+         jax.jit(lambda g: jnp.median(g, axis=0)), None),
+    ]
+    if n <= 2048 or args.rehearse:
+        # The exact on-device Bulyan route (selection loop is O(n) trips
+        # of O(n²)); bounded to sizes where one execution fits a step.
+        cells.append((
+            "bulyan_pallas_route",
+            jax.jit(lambda g: bulyan(g, n, f, selection_impl="pallas",
+                                     trim_impl="pallas"),
+                    static_argnums=()),
+            jax.jit(lambda g: bulyan(g, n, f)), None))
+
+    rc = 0
+    for tag, pal_fn, ref_fn, declared in cells:
+        row = {"kernel": tag, "n": n, "d": d, "f": f,
+               "backend": dev.platform, "mosaic": bool(on_accel),
+               "tiles": tiles if tag == "krum_score_fusion" else None}
+        try:
+            t0 = time.perf_counter()
+            lowered = pal_fn.lower(G)
+            compiled = lowered.compile()
+            row["compile_s"] = round(time.perf_counter() - t0, 2)
+            try:
+                from attacking_federate_learning_tpu.utils.costs import (
+                    compiled_cost_facts
+                )
+                row["cost"] = {k: v for k, v in
+                               compiled_cost_facts(compiled).items()
+                               if k in ("flops", "bytes_accessed",
+                                        "temp_bytes")}
+            except Exception:
+                pass
+            if declared:
+                row["declared"] = declared
+            wall, out = timed(lambda: pal_fn(G))
+            row["wall_ms"] = round(wall, 2)
+            ref_wall, ref_out = timed(lambda: ref_fn(G))
+            row["xla_wall_ms"] = round(ref_wall, 2)
+            got, want = np.asarray(out), np.asarray(ref_out)
+            denom = np.maximum(np.abs(want), 1e-6)
+            row["max_rel_err"] = float(np.max(np.abs(got - want) / denom))
+            row["parity_ok"] = bool(row["max_rel_err"] < 5e-3)
+            if not row["parity_ok"]:
+                rc = 1
+        except Exception as e:      # noqa: BLE001 — a Mosaic lowering
+            # failure is exactly the evidence this step exists to bank
+            row["error"] = f"{type(e).__name__}: {e}"
+            rc = 1
+        log(f"  {tag}: " + (f"{row.get('wall_ms')} ms (xla "
+                            f"{row.get('xla_wall_ms')} ms), rel "
+                            f"{row.get('max_rel_err'):.2e}"
+                            if "wall_ms" in row
+                            else row.get("error", "?")))
+        print(json.dumps(row), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

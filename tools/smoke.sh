@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # One-liner CI smoke: event-schema validation + fault matrix + crash
-# matrix + perf gate (incl. hierarchical memproof + secagg wireproof) +
+# matrix + perf gate (incl. hierarchical memproof + secagg wireproof +
+# pallas fusion proof) +
 # science gate + registry selfcheck + hierarchical-aggregation smoke +
 # secure-aggregation smoke + hierarchical-telemetry/forensics smoke +
 # asynchronous-rounds smoke + campaign-engine kill/resume smoke.
@@ -92,7 +93,7 @@ else
     echo "== smoke 3/11: crash_matrix — skipped (--fast) =="
 fi
 
-echo "== smoke 4/11: perf_gate (+ hierarchical memproof) =="
+echo "== smoke 4/11: perf_gate (+ memproof + wireproof + pallasproof) =="
 python tools/perf_gate.py --memproof || fail=1
 
 echo "== smoke 5/11: science_gate (behavioral drift) =="

@@ -60,10 +60,12 @@ if [ "$REHEARSE" = 1 ]; then
   # compile is impractical on one CPU core); cell-5 has its own
   # dedicated overnight job.
   STEP3_CELLS=()
+  MB_ARGS=(--rehearse)    # pallas micro-bench: tiny shapes, interpret
   probe() { return 0; }
 else
   STEP2_ENV=(env FL_TEST_TPU=1)
   STEP3_CELLS=(--cells 1,2,3,4)
+  MB_ARGS=()              # pallas micro-bench: Mosaic compile, 2048c
   probe() { relay_probe; }
 fi
 
@@ -143,6 +145,17 @@ echo "   engine, defense kernels incl. the hybrid Bulyan callback) =="
 budget "step2-pytest"
 
 probe || { echo "relay died after pytest" >&2; exit 1; }
+echo "== step 2.5: pallas defense-kernel micro-bench (Mosaic compile) =="
+# First hard evidence the ops/pallas_defense.py kernels lower through
+# Mosaic + their on-chip walls vs the XLA references (ISSUE 11); a
+# lowering failure banks the error JSON instead of killing the window.
+"${SUP[@]}" timeout 1800 python tools/pallas_microbench.py \
+  ${MB_ARGS[@]+"${MB_ARGS[@]}"} >"$OUT/pallas_$STAMP.jsonl" \
+  2>>"$OUT/pallas_$STAMP.log" || true
+cat "$OUT/pallas_$STAMP.jsonl"
+budget "step2.5-pallas-microbench"
+
+probe || { echo "relay died after pallas micro-bench" >&2; exit 1; }
 echo "== step 3: BASELINE cells =="
 "${SUP[@]}" timeout 7200 python -m attacking_federate_learning_tpu.benchmarks \
   --rounds 10 ${STEP3_CELLS[@]+"${STEP3_CELLS[@]}"} 2>&1 \
