@@ -222,6 +222,21 @@ def validate_composition(cfg: ExperimentConfig,
               else tier2_assumed(f, cfg.megabatch))
         check_tier2_args(cfg.defense, cfg.megabatch, t1)
         check_tier2_args(cfg.tier2_defense or cfg.defense, S, t2)
+        if cfg.mesh_shape is not None and cfg.mesh_shape[0] > 1:
+            # The SPMD client_map's schedule check, via the SAME
+            # function the engine init calls (ops/federated.py
+            # spmd_schedule — ISSUE 12) so the pre-check and the real
+            # rejection cannot drift: an S not divisible by the mesh
+            # clients axis becomes a skipped cell, never a crash.
+            # Host-side numpy only — no jax op, no device needed.
+            from attacking_federate_learning_tpu.ops.federated import (
+                make_placement, spmd_schedule
+            )
+
+            spmd_schedule(
+                make_placement(cfg.users_count, f, cfg.megabatch,
+                               cfg.mal_placement),
+                cfg.mesh_shape[0])
     elif cfg.aggregation == "async":
         from attacking_federate_learning_tpu.core.async_rounds import (
             check_async_support
@@ -281,14 +296,19 @@ class Cell:
                "index": self.index}
         # The impl knobs ride along so `runs campaign` can render
         # impl-comparison tables (xla vs pallas vs host sweeps,
-        # ISSUE 11) straight from the journal rows.
+        # ISSUE 11) straight from the journal rows; the mesh/topology
+        # knobs (ISSUE 12) let the same tables split SPMD vs scan
+        # hierarchical cells.
         for k in ("dataset", "defense", "seed", "epochs", "aggregation",
                   "secagg", "aggregation_impl", "distance_impl",
-                  "bulyan_selection_impl"):
+                  "bulyan_selection_impl", "mesh_shape", "megabatch",
+                  "mal_placement"):
             if self.cfg is not None:
                 out[k] = getattr(self.cfg, k)
             elif k in self.overrides:
                 out[k] = self.overrides[k]
+        if isinstance(out.get("mesh_shape"), tuple):
+            out["mesh_shape"] = list(out["mesh_shape"])  # JSONL-stable
         return out
 
 

@@ -146,7 +146,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="'clients,model' device split, e.g. 8,1; "
                         "'none' clears an earlier --mesh-shape (argparse "
                         "last-wins — the supervisor's OOM degradation "
-                        "appends it to relax the MeshPlan)")
+                        "appends it to relax the MeshPlan).  Under "
+                        "--aggregation hierarchical a clients axis > 1 "
+                        "runs tier-1 as one SPMD shard_map program "
+                        "(each device scans its own megabatches; "
+                        "n/megabatch must divide the clients axis)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize client activations in the backward "
                         "pass (jax.checkpoint) — trades FLOPs for HBM at "

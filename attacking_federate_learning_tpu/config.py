@@ -473,6 +473,17 @@ class ExperimentConfig:
             raise ValueError(
                 f"stream_prefetch must be >= 1 and stream_workers 0 or 1, "
                 f"got {self.stream_prefetch}/{self.stream_workers}")
+        if self.mesh_shape is not None:
+            # Normalized to a tuple so a JSON campaign spec's list and
+            # the CLI's tuple hash to the same run/cell identity.
+            ms = tuple(self.mesh_shape)
+            if len(ms) != 2 or any(
+                    not isinstance(x, int) or x < 1 for x in ms):
+                raise ValueError(
+                    f"mesh_shape must be two positive ints "
+                    f"(clients_devices, model_devices), "
+                    f"got {self.mesh_shape!r}")
+            self.mesh_shape = ms
         if self.bulyan_batch_select < 1:
             raise ValueError(
                 f"bulyan_batch_select must be >= 1, got "

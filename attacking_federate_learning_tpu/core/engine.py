@@ -125,10 +125,19 @@ class FederatedExperiment:
             self._secagg_key = secagg_key(cfg)
         else:
             self._secagg = None
+        # Mesh plan first: the hierarchical init below decides between
+        # the sequential megabatch scan and the SPMD client_map from
+        # the clients-axis size (ISSUE 12), so the plan must exist
+        # before the topology is planned.
+        if shardings is None and cfg.mesh_shape is not None:
+            from attacking_federate_learning_tpu.parallel.mesh import make_plan
+            shardings = make_plan(tuple(cfg.mesh_shape))
+        self.shardings = shardings  # parallel.MeshPlan or None (single device)
         # The defense only ever sees the round cohort (flat), one
         # megabatch / the shard-estimate matrix (hierarchical), or the
         # delivered sub-cohort (async).
         self._async = None
+        self._hier_spmd = False
         if cfg.aggregation == "hierarchical":
             self._init_hierarchical()
         elif cfg.aggregation == "async":
@@ -157,10 +166,6 @@ class FederatedExperiment:
         else:
             self.faults = None
         self._part_key = jax.random.key(cfg.seed ^ 0x9A47)
-        if shardings is None and cfg.mesh_shape is not None:
-            from attacking_federate_learning_tpu.parallel.mesh import make_plan
-            shardings = make_plan(tuple(cfg.mesh_shape))
-        self.shardings = shardings  # parallel.MeshPlan or None (single device)
         self._krum_select_fn = None  # set for Krum (selection telemetry)
         self.last_round_telemetry = None   # cfg.telemetry, per-round modes
         self.last_span_telemetry = None    # cfg.telemetry, fused spans
@@ -253,7 +258,8 @@ class FederatedExperiment:
             if shardings is not None:
                 self.shards, self.train_x, self.train_y, self.state = (
                     shardings.place(self.shards, self.train_x, self.train_y,
-                                    self.state))
+                                    self.state,
+                                    replicate_shards=self._hier_spmd))
 
         # FEMNIST-style feature shift (SURVEY §7.2 M4): each client sees
         # the shared pool through its own affine transform a_i*x + b_i
@@ -376,6 +382,24 @@ class FederatedExperiment:
 
         self._placement = make_placement(self.n, self.f, cfg.megabatch,
                                          cfg.mal_placement)
+        # SPMD tier-1 (ISSUE 12): a mesh whose clients axis holds > 1
+        # device maps the megabatch axis onto it — each device scans
+        # its own megabatches, tier-2 reads one explicit all_gather.
+        # The schedule is validated NOW (S % clients axis, loudly)
+        # rather than deep in a trace; a 1-device clients axis keeps
+        # the sequential scan, byte-identical HLO included.
+        if self.shardings is not None:
+            from attacking_federate_learning_tpu.ops.federated import (
+                spmd_schedule
+            )
+            from attacking_federate_learning_tpu.parallel.mesh import (
+                CLIENTS
+            )
+
+            parts = self.shardings.mesh.shape[CLIENTS]
+            if parts > 1:
+                spmd_schedule(self._placement, parts)
+                self._hier_spmd = True
         S = self._placement.num_shards
         self._tier1_f = (cfg.tier1_corrupted
                          if cfg.tier1_corrupted is not None
@@ -1141,7 +1165,11 @@ class FederatedExperiment:
             grads = self._client_update(state.weights, xs, ys, lr_train,
                                         lr_report)
             grads = grads.astype(self._grad_dtype)
-            if self.shardings is not None:
+            if self.shardings is not None and not self._hier_spmd:
+                # Under the SPMD client_map the body is device-local
+                # code inside shard_map — a global sharding constraint
+                # has no meaning there (the megabatch grid IS the
+                # sharded operand).
                 grads = self.shardings.constrain_grads(grads)
             grads = self.attacker.apply(grads, c_mal, ctx_for(state, t))
             bad = (
@@ -1190,9 +1218,17 @@ class FederatedExperiment:
                     grads.astype(jnp.float32), axis=1)
             return out
 
+        # SPMD: client_map runs the shard_map mapping (each device owns
+        # its megabatches, one explicit all_gather of the estimates);
+        # the gathered (S, ...) outputs come back REPLICATED, so the
+        # tier-2 resharding constraint is skipped — re-annotating a
+        # replicated matrix is exactly the GSPMD seam being retired.
+        cm_plan = self.shardings if self._hier_spmd else None
+        t2_plan = None if self._hier_spmd else self.shardings
+
         def hier_core(state, t):
             tele = {}
-            out = client_map(shard_fn, place, state, t)
+            out = client_map(shard_fn, place, state, t, plan=cm_plan)
             norms = diag1 = sum_oks = None
             if extras:
                 ests, bads = out["est"], out["bad"]
@@ -1232,7 +1268,7 @@ class FederatedExperiment:
                 if norms is not None:
                     tele["shard_grad_norms"] = norms
                 agg, diag2 = shard_reduce(tier2_fn, ests, S, f2,
-                                          plan=self.shardings,
+                                          plan=t2_plan,
                                           telemetry=True)
                 for dk, dv in diag2.items():
                     tele["tier2_" + dk] = dv
@@ -1240,7 +1276,7 @@ class FederatedExperiment:
                     ests.astype(jnp.float32), axis=1)
             else:
                 agg = shard_reduce(tier2_fn, ests, S, f2,
-                                   plan=self.shardings)
+                                   plan=t2_plan)
             new_state = self._aggregate_impl(state, None, t, agg=agg)
             bad = (bads.any() if self._check_attack_nan
                    else jnp.asarray(False))

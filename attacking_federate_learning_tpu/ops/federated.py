@@ -37,6 +37,23 @@ statistics (ALIE's mean/std envelope) are per-megabatch, not global.
 Scan shapes must be static, so megabatches are grouped by their
 malicious-row count and one scan runs per distinct count (≤ 3 groups:
 full/partial/zero under 'concentrated', hi/lo under 'spread').
+
+SPMD tier-1 (ISSUE 12): with a MeshPlan whose ``clients`` axis holds
+more than one device, ``client_map`` stops being a sequential scan and
+becomes one ``shard_map`` program over the clients axis: each device
+scans ONLY its own megabatches locally (one megabatch's intermediates
+live per device — the O(m·d) contract survives per shard), and the
+stacked per-device outputs meet in one explicit tiled ``all_gather``
+— O(S·d) bytes on the wire — so tier-2 reads a replicated, ordered
+(S, d) estimate matrix with no GSPMD resharding seam (the
+"involuntary full rematerialization" warning the MULTICHIP dryruns
+logged came from exactly that seam).  :func:`spmd_schedule` is the
+host-side plan: S must divide by the clients axis (rejected loudly —
+silent replication would defeat the sharding), and a placement group
+whose megabatch count does not divide evenly is padded with DUPLICATE
+megabatches (bounded: < clients-axis extra rows per group, dropped
+after the gather by the ``select`` index) so every device runs the
+same static program without changing any estimate.
 """
 
 from __future__ import annotations
@@ -115,6 +132,107 @@ def make_placement(n: int, f: int, megabatch: int,
                      groups=groups, megabatch=m, num_shards=S)
 
 
+class SpmdSchedule(NamedTuple):
+    """Host-side SPMD plan for :func:`client_map` over the mesh
+    ``clients`` axis: one padded id grid per placement group (shape
+    ``(k_g * parts, m)`` — device q owns rows ``[q*k_g, (q+1)*k_g)``),
+    the group's static malicious counts, and ``select`` — for each
+    megabatch id, the row it lands on in the device-major
+    ``all_gather`` order (also the dedup: padded duplicate rows are
+    simply never selected)."""
+
+    grids: Tuple[np.ndarray, ...]      # per group: (k_g*parts, m) ids
+    counts: Tuple[int, ...]            # per group static malicious rows
+    select: np.ndarray                 # (S,) gathered-row index per shard
+    parts: int                         # mesh clients-axis size
+    padded_shards: int                 # total scheduled rows (>= S)
+
+
+def spmd_schedule(placement: Placement, parts: int) -> SpmdSchedule:
+    """Deal the placement's megabatches across the mesh clients axis.
+
+    ``parts`` is the clients-axis device count.  The shard count S must
+    be divisible by it — anything else would silently replicate work
+    (the exact failure mode the SPMD mapping exists to retire), so it
+    is rejected loudly with the knobs named.  WITHIN a group a
+    non-divisible megabatch count is legal: the group is padded with
+    duplicates of its first megabatch (< parts extra rows per group,
+    pure redundant compute whose outputs ``select`` drops), because
+    every device must run the same static per-group scan."""
+    S = placement.num_shards
+    if parts < 1:
+        raise ValueError(f"mesh clients axis must be >= 1, got {parts}")
+    if S % parts:
+        raise ValueError(
+            f"hierarchical SPMD tier-1 needs the megabatch count "
+            f"S = users_count/megabatch divisible by the mesh clients "
+            f"axis (S={S}, clients axis={parts}): pick --megabatch / "
+            f"--mesh-shape so S % clients == 0 — silently replicating "
+            f"megabatches across devices would defeat the sharding")
+    grids, counts, per_dev = [], [], []
+    for count, sids in placement.groups:
+        k = -(-len(sids) // parts)
+        padded = list(sids) + [sids[0]] * (k * parts - len(sids))
+        grids.append(placement.grid[padded])
+        counts.append(count)
+        per_dev.append(k)
+    k_sum = sum(per_dev)
+    select = np.empty(S, np.int64)
+    for gi, (_, sids) in enumerate(placement.groups):
+        k, off = per_dev[gi], sum(per_dev[:gi])
+        for r, sid in enumerate(sids):
+            q, j = divmod(r, k)
+            select[sid] = q * k_sum + off + j
+    return SpmdSchedule(grids=tuple(grids), counts=tuple(counts),
+                        select=select, parts=parts,
+                        padded_shards=k_sum * parts)
+
+
+def _client_map_spmd(shard_fn, placement: Placement, plan, *args):
+    """One true SPMD program for the megabatch axis: a ``shard_map``
+    over the mesh ``clients`` axis in which each device runs the
+    group scans over ITS megabatch rows only, then one explicit tiled
+    ``all_gather`` per output leaf — O(S · leaf_row_bytes) collective
+    traffic — hands every device the full device-major stack, and the
+    host-computed ``select`` gather restores megabatch order (and
+    drops padding duplicates).  Output pytree: identical structure,
+    shapes and (ulp-band) values to the sequential scan path."""
+    import functools
+
+    from attacking_federate_learning_tpu.parallel.distances import (
+        _pvary, shard_map
+    )
+    from attacking_federate_learning_tpu.parallel.mesh import CLIENTS
+    from jax.sharding import PartitionSpec as P
+
+    sched = spmd_schedule(placement, plan.mesh.shape[CLIENTS])
+    grids = tuple(jnp.asarray(g) for g in sched.grids)
+
+    @functools.partial(
+        shard_map, mesh=plan.mesh,
+        in_specs=tuple(P(CLIENTS, None) for _ in grids),
+        out_specs=P(), check_rep=False)
+    def run(*dev_grids):
+        pieces = []
+        for count, grid in zip(sched.counts, dev_grids):
+
+            def body(carry, ids, _c=count):
+                return carry, shard_fn(ids, _c, *args)
+
+            _, stacked = lax.scan(
+                body, _pvary(jnp.zeros((), jnp.int32), CLIENTS), grid)
+            pieces.append(stacked)
+        local = (pieces[0] if len(pieces) == 1
+                 else jax.tree_util.tree_map(
+                     lambda *xs: jnp.concatenate(xs, axis=0), *pieces))
+        return jax.tree_util.tree_map(
+            lambda x: lax.all_gather(x, CLIENTS, tiled=True), local)
+
+    out = run(*grids)
+    sel = jnp.asarray(sched.select)
+    return jax.tree_util.tree_map(lambda a: a[sel], out)
+
+
 def broadcast(value, plan=None):
     """Server -> clients broadcast.  Functionally the identity (the
     scanned client_map closes over the value and XLA replicates it);
@@ -128,7 +246,7 @@ def broadcast(value, plan=None):
     return lax.with_sharding_constraint(value, plan.sharding(P()))
 
 
-def client_map(shard_fn, placement: Placement, *args):
+def client_map(shard_fn, placement: Placement, *args, plan=None):
     """Stream ``shard_fn`` over the client axis, one megabatch at a time.
 
     ``shard_fn(ids, mal_count, *args) -> pytree`` receives a traced
@@ -138,7 +256,18 @@ def client_map(shard_fn, placement: Placement, *args):
     shard axis, in megabatch order — the (n/m, ...) shard-estimate
     matrix.  One ``lax.scan`` per placement group (distinct malicious
     count), so only one megabatch's intermediates are live at a time.
+
+    ``plan``: a MeshPlan whose ``clients`` axis holds > 1 device
+    switches to the SPMD mapping (:func:`_client_map_spmd`) — devices
+    scan their own megabatches concurrently and meet in one explicit
+    all_gather.  ``None`` (or a 1-device clients axis) is the
+    sequential scan, byte-for-byte the pre-SPMD program.
     """
+    if plan is not None:
+        from attacking_federate_learning_tpu.parallel.mesh import CLIENTS
+
+        if plan.mesh.shape[CLIENTS] > 1:
+            return _client_map_spmd(shard_fn, placement, plan, *args)
     pieces, order = [], []
     for count, sids in placement.groups:
         grid = jnp.asarray(placement.grid[list(sids)])
@@ -184,8 +313,8 @@ def shard_reduce(tier2_fn, estimates, num_shards: int,
 
 def two_tier_aggregate(users_grads, placement: Placement, tier1_fn,
                        tier2_fn, tier1_corrupted: int,
-                       tier2_corrupted: int, mask=None, plan=None,
-                       telemetry=False):
+                       tier2_corrupted: int, mask=None, weights=None,
+                       plan=None, telemetry=False):
     """Reference two-tier aggregation over a MATERIALIZED (n, d) matrix.
 
     The engine's hierarchical round never builds this matrix (gradients
@@ -203,10 +332,23 @@ def two_tier_aggregate(users_grads, placement: Placement, tier1_fn,
     shard's sub-matrix, the bit-match contract the engine's
     shard_selection events inherit — and ``tier2_diag`` is the
     shard_* entry's (S,)-shaped selection record.
+
+    ``weights`` (n,) threads each megabatch's rows through the
+    kernels' staleness-weight seam (requires ``mask`` — the kernels
+    reject weights without a delivered-cohort mask); ``plan`` with a
+    multi-device clients axis runs the SPMD client_map (the estimates
+    come back replicated from the explicit all_gather, so the tier-2
+    resharding constraint is skipped — there is nothing to reshard).
     """
     m = placement.megabatch
+    if weights is not None and mask is None:
+        from attacking_federate_learning_tpu.defenses.kernels import (
+            check_weight_seam
+        )
 
-    def shard_fn(ids, _c, G, gmask):
+        check_weight_seam(mask, weights)   # raises, naming the seam
+
+    def shard_fn(ids, _c, G, gmask, gw):
         rows = G[ids]
         if gmask is None:
             if not telemetry:
@@ -216,15 +358,23 @@ def two_tier_aggregate(users_grads, placement: Placement, tier1_fn,
                                  telemetry=True)
             return est.astype(jnp.float32), diag
         sm = gmask[ids]
+        kw = {} if gw is None else {"weights": gw[ids]}
         if not telemetry:
-            est = tier1_fn(rows, m, tier1_corrupted, mask=sm)
+            est = tier1_fn(rows, m, tier1_corrupted, mask=sm, **kw)
             return est.astype(jnp.float32), jnp.sum(sm).astype(jnp.int32)
         est, diag = tier1_fn(rows, m, tier1_corrupted, mask=sm,
-                             telemetry=True)
+                             telemetry=True, **kw)
         return (est.astype(jnp.float32), jnp.sum(sm).astype(jnp.int32),
                 diag)
 
-    out = client_map(shard_fn, placement, users_grads, mask)
+    out = client_map(shard_fn, placement, users_grads, mask, weights,
+                     plan=plan)
+    spmd = False
+    if plan is not None:
+        from attacking_federate_learning_tpu.parallel.mesh import CLIENTS
+
+        spmd = plan.mesh.shape[CLIENTS] > 1
+    tier2_plan = None if spmd else plan
     t1_diag = None
     if mask is None:
         if telemetry:
@@ -239,10 +389,10 @@ def two_tier_aggregate(users_grads, placement: Placement, tier1_fn,
     if not telemetry:
         return shard_reduce(tier2_fn, estimates, placement.num_shards,
                             tier2_corrupted, alive_counts=alive,
-                            plan=plan)
+                            plan=tier2_plan)
     agg, t2_diag = shard_reduce(tier2_fn, estimates,
                                 placement.num_shards, tier2_corrupted,
-                                alive_counts=alive, plan=plan,
+                                alive_counts=alive, plan=tier2_plan,
                                 telemetry=True)
     return agg, t1_diag, t2_diag
 

@@ -73,12 +73,26 @@ class MeshPlan(NamedTuple):
                                     if leaf.ndim >= 1 else P())),
             state)
 
-    def place(self, shards, train_x, train_y, state):
+    @property
+    def clients_parts(self) -> int:
+        """Clients-axis device count — > 1 switches the hierarchical
+        engine onto the SPMD client_map (ops/federated.py, ISSUE 12)."""
+        return self.mesh.shape[CLIENTS]
+
+    def place(self, shards, train_x, train_y, state,
+              replicate_shards=False):
         """Initial placement: client-index matrix sharded over clients,
         dataset replicated (MNIST/CIFAR fit in HBM; beyond-HBM data stays
         on host via data/stream.py, SURVEY.md §7.3 #5), server state
-        sharded over the model axis."""
-        shards = jax.device_put(shards, self.sharding(P(CLIENTS, None)))
+        sharded over the model axis.
+
+        ``replicate_shards``: the SPMD hierarchical engine closes over
+        the client->sample matrix inside shard_map, where captures are
+        replicated by definition — placing it replicated up front keeps
+        the capture from smuggling a resharding collective into every
+        round (the megabatch id grids are the sharded operands there)."""
+        shard_spec = P() if replicate_shards else P(CLIENTS, None)
+        shards = jax.device_put(shards, self.sharding(shard_spec))
         train_x = jax.device_put(train_x, self.sharding(P()))
         train_y = jax.device_put(train_y, self.sharding(P()))
         return shards, train_x, train_y, self.place_state(state)
@@ -88,15 +102,26 @@ class MeshPlan(NamedTuple):
             grads, self.sharding(self.grads_spec(grads.shape[-1])))
 
     # --- hierarchical (megabatch) composition --------------------------
-    # The two-tier engine (ops/federated.py) streams the client axis as
-    # lax.scan megabatches; inside the scan each (m, d) megabatch
-    # gradient matrix carries the SAME ('clients', model) layout as the
-    # flat (n, d) matrix — the scan axis replaces n, the mesh axes are
-    # untouched, so constrain_grads composes unchanged (GSPMD pads an
-    # uneven m over the clients axis the same way it pads n).  The
-    # (n/m, d) shard-estimate matrix rides the clients axis only when
-    # the shard count divides it; otherwise it replicates (S is small —
-    # the tier-2 pass is cheap either way).
+    # Two regimes (core/engine.py decides by clients_parts):
+    #
+    # 1-device clients axis — the sequential scan: inside the scan each
+    # (m, d) megabatch gradient matrix carries the SAME
+    # ('clients', model) layout as the flat (n, d) matrix — the scan
+    # axis replaces n, the mesh axes are untouched, so constrain_grads
+    # composes unchanged (GSPMD pads an uneven m over the clients axis
+    # the same way it pads n).  estimates_spec/constrain_estimates
+    # below annotate the (n/m, d) shard-estimate matrix for THIS
+    # regime's tier-2 pass (it rides the clients axis only when the
+    # shard count divides it; otherwise it replicates).
+    #
+    # Multi-device clients axis — the SPMD client_map (ISSUE 12,
+    # ops/federated.py:_client_map_spmd): the MEGABATCH axis is the
+    # sharded axis (id grids enter shard_map split P(clients, None)),
+    # each device scans its own megabatches, and the estimates come
+    # back replicated from one explicit tiled all_gather — so the
+    # tier-2 pass needs NO estimates constraint at all; re-annotating
+    # the replicated matrix would reintroduce the GSPMD resharding
+    # seam the mapping exists to retire.
 
     def estimates_spec(self, num_shards: int, d: int):
         clients = (CLIENTS if num_shards % self.mesh.shape[CLIENTS] == 0

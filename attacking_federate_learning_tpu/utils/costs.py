@@ -56,7 +56,11 @@ class CostRecord:
     ``flops`` / ``bytes_accessed`` are exact for a given (HLO, XLA,
     platform); ``peak_bytes`` is the argument+output+temp−alias proxy
     (an upper bound on resident executable memory, compared with a
-    tolerance by the perf gate).  ``cache`` is 'hit' | 'miss' |
+    tolerance by the perf gate).  ``collective_bytes`` sums the output
+    bytes of every cross-device collective in the compiled (post-SPMD)
+    program — 0 for single-device programs, the wire-traffic witness
+    for sharded ones (tools/perf_gate.py ``--shardproof`` pins the
+    hierarchical SPMD round at O(S·d)).  ``cache`` is 'hit' | 'miss' |
     'uncached'; ``compile_s`` is the observed ``.compile()`` wall time
     (diagnostic only — never gated on)."""
 
@@ -69,6 +73,7 @@ class CostRecord:
     temp_bytes: int = 0
     alias_bytes: int = 0
     generated_code_bytes: int = 0
+    collective_bytes: int = 0
     compile_s: float = 0.0
     cache: str = "uncached"
 
@@ -85,7 +90,8 @@ class CostRecord:
                     argument_bytes=self.argument_bytes,
                     output_bytes=self.output_bytes,
                     temp_bytes=self.temp_bytes,
-                    generated_code_bytes=self.generated_code_bytes)
+                    generated_code_bytes=self.generated_code_bytes,
+                    collective_bytes=self.collective_bytes)
 
     def compile_event(self) -> dict:
         """Payload for a 'compile' event (metrics.py schema v2)."""
@@ -100,7 +106,8 @@ class CostRecord:
                 "argument_bytes": self.argument_bytes,
                 "output_bytes": self.output_bytes,
                 "temp_bytes": self.temp_bytes,
-                "peak_bytes": self.peak_bytes}
+                "peak_bytes": self.peak_bytes,
+                "collective_bytes": self.collective_bytes}
 
 
 # --- persistent-cache hit/miss accounting ------------------------------
@@ -159,6 +166,54 @@ def _cache_entries(path: Optional[str]) -> Optional[frozenset]:
         return None
 
 
+# --- collective (cross-device) traffic accounting ----------------------
+
+# Collective ops as they appear in optimized HLO text; async pairs
+# (-start/-done) are counted once via -start, and '-done' is excluded
+# so the same transfer is never double-billed.
+_COLLECTIVE_RE = None
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+                "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+                "s32": 4, "u32": 4, "f32": 4,
+                "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16}
+
+
+def collective_hlo_bytes(text: str) -> dict:
+    """Sum output bytes of every cross-device collective in an HLO
+    module text (compiled/post-SPMD: shapes are per-device, so the
+    totals are what one device moves).  Returns ``{'total': int,
+    'per_op': {op: bytes}}``; 0/empty for single-device programs.
+
+    The byte count is the op's OUTPUT shape(s) — the received data,
+    the convention the perf gate's O(S·d) bound is written against
+    (an all-gather's output is the gathered matrix; a ppermute's is
+    one block)."""
+    import re
+
+    global _COLLECTIVE_RE
+    if _COLLECTIVE_RE is None:
+        _COLLECTIVE_RE = re.compile(
+            r"=\s+(?P<out>[^=]*?)\s+"
+            r"(?P<op>all-gather|all-reduce|reduce-scatter|"
+            r"collective-permute|all-to-all)(?P<start>-start)?\(")
+    shape_re = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+    per_op: dict = {}
+    for m in _COLLECTIVE_RE.finditer(text):
+        op = m.group("op")
+        nbytes = 0
+        for dtype, dims in shape_re.findall(m.group("out")):
+            width = _DTYPE_BYTES.get(dtype)
+            if width is None:
+                continue          # layout braces etc. never match here
+            elems = 1
+            for d in filter(None, dims.split(",")):
+                elems *= int(d)
+            nbytes += elems * width
+        per_op[op] = per_op.get(op, 0) + nbytes
+    return {"total": sum(per_op.values()), "per_op": per_op}
+
+
 # --- per-entry-point analysis ------------------------------------------
 
 def _first(d):
@@ -176,7 +231,7 @@ def compiled_cost_facts(compiled) -> dict:
     a reader can tell "not measured" from a real zero."""
     out = {"flops": -1.0, "bytes_accessed": -1.0, "argument_bytes": 0,
            "output_bytes": 0, "temp_bytes": 0, "alias_bytes": 0,
-           "generated_code_bytes": 0}
+           "generated_code_bytes": 0, "collective_bytes": 0}
     try:
         ca = _first(compiled.cost_analysis())
     except Exception:
@@ -184,6 +239,11 @@ def compiled_cost_facts(compiled) -> dict:
     for key, field in _COST_KEYS.items():
         if key in ca:
             out[field] = float(ca[key])
+    try:
+        out["collective_bytes"] = collective_hlo_bytes(
+            compiled.as_text())["total"]
+    except Exception:
+        pass                       # text unavailable on some backends
     try:
         ma = compiled.memory_analysis()
     except Exception:

@@ -89,8 +89,27 @@ CELLS = {
                              aggregation_impl="pallas"),
 }
 
-EXACT = ("flops", "bytes_accessed", "argument_bytes", "output_bytes")
+EXACT = ("flops", "bytes_accessed", "argument_bytes", "output_bytes",
+         "collective_bytes")
 TOLERANT = ("temp_bytes", "peak_bytes")
+
+
+def _ensure_virtual_devices(n: int = 8) -> None:
+    """Raise the virtual CPU device count to n BEFORE backend init so
+    the shardproof leg can build an 8-device mesh in a standalone run
+    (same lazily-read XLA_FLAGS seam as __graft_entry__.py; a no-op
+    when jax's backend already initialized — shardproof then checks
+    the live device count and skips loudly if it is short)."""
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    elif int(m.group(1)) < n:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n}")
 
 
 def environment() -> dict:
@@ -343,6 +362,157 @@ def pallasproof() -> int:
           f"({100 * ratio:.0f}%); no {nn} tensor on the pallas route "
           f"(tile traffic {model['hbm_tile_bytes'] / 1e9:.0f} GB at "
           f"CI blocks)")
+    return shardproof()
+
+
+# --- hierarchical SPMD proof (ISSUE 12 acceptance) ---------------------
+# Baseline-free like the memproof.  Three structural facts about the
+# SPMD tier-1 mapping (ops/federated.py:_client_map_spmd), all provable
+# on the 8-virtual-CPU-device mesh with no hardware:
+#
+# (a) scan-path fidelity: for EVERY pinned hierarchical cell, the
+#     engine built on a 1-device clients axis produces an entry ledger
+#     whose exact facts (FLOPs/bytes/args/outputs, collective bytes=0)
+#     EQUAL the no-mesh scan path's — the mesh knobs must not perturb
+#     the sequential program (its HLO differs only in the sharding-
+#     propagation header any MeshPlan has always stamped);
+# (b) the 8-device hier round is truly sharded: the compiled per-
+#     device program holds NO full (n, d) / (S, m, d) / (n, n) tensor
+#     (the "involuntary full rematerialization" seam is gone), and its
+#     collective traffic is pinned to the explicit estimate all_gather
+#     — within [1.0, 1.25]x of S*d*4 bytes;
+# (c) sharded == unsharded: a 2-round SPMD run reproduces the scan
+#     path's weights inside the measured ulp band (bit-equal on this
+#     box; the tolerance covers GSPMD reduction reordering on others).
+
+SHARDPROOF = dict(n=64, m=4, mesh_clients=8, coll_slack=1.25,
+                  atol=2e-5)
+
+
+def _hier_experiment(shardings, **overrides):
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.attacks import DriftAttack
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+
+    base = dict(
+        dataset=C.SYNTH_MNIST, users_count=11, mal_prop=0.2,
+        batch_size=16, epochs=5, test_step=5, seed=0,
+        synth_train=256, synth_test=64)
+    base.update(overrides)
+    cfg = ExperimentConfig(**base)
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=256, synth_test=64)
+    return FederatedExperiment(cfg, attacker=DriftAttack(1.5), dataset=ds,
+                               shardings=shardings)
+
+
+def shardproof() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from attacking_federate_learning_tpu.parallel.mesh import make_plan
+    from attacking_federate_learning_tpu.utils.costs import (
+        compiled_cost_facts
+    )
+
+    if len(jax.devices()) < 8:
+        print(f"SKIP perf_gate shardproof: needs 8 (virtual) devices, "
+              f"have {len(jax.devices())} — the backend initialized "
+              f"before the device-count flag could apply; run "
+              f"tools/perf_gate.py standalone (it raises the count "
+              f"itself) or under the test harness")
+        return 0
+
+    problems = []
+
+    # (a) scan-path fidelity on a 1-device clients axis, per hier cell.
+    plan1 = make_plan((1, 1), devices=jax.devices()[:1])
+    hier_cells = sorted(c for c in CELLS if c.startswith("hier_"))
+    for cell in hier_cells:
+        ref = _hier_experiment(None, **CELLS[cell]).cost_report()
+        got = _hier_experiment(plan1, **CELLS[cell]).cost_report()
+        if ref.errors or got.errors:
+            problems.append(f"shardproof[{cell}]: cost analysis failed "
+                            f"({ref.errors + got.errors})")
+            continue
+        want, have = ref.summary(), got.summary()
+        if set(want) != set(have):
+            problems.append(
+                f"shardproof[{cell}]: 1-device-mesh entry points "
+                f"{sorted(have)} != scan path's {sorted(want)}")
+            continue
+        for entry, facts in want.items():
+            for metric in EXACT:
+                if have[entry].get(metric) != facts.get(metric):
+                    problems.append(
+                        f"shardproof[{cell}].{entry}.{metric}: "
+                        f"1-device mesh {have[entry].get(metric)} != "
+                        f"scan path {facts.get(metric)} — the mesh "
+                        f"knobs changed the sequential program")
+            if have[entry].get("collective_bytes"):
+                problems.append(
+                    f"shardproof[{cell}].{entry}: collective ops on a "
+                    f"1-device mesh (the scan path grew a collective)")
+
+    # (b) structural facts of the 8-device SPMD round.
+    n, m = SHARDPROOF["n"], SHARDPROOF["m"]
+    plan8 = make_plan((SHARDPROOF["mesh_clients"], 1))
+    exp8 = _hier_experiment(
+        plan8, users_count=n, mal_prop=0.25, defense="Krum",
+        aggregation="hierarchical", megabatch=m)
+    d, S = exp8.flat.dim, n // m
+    compiled = exp8._fused_round.lower(
+        exp8.state, jnp.asarray(0, jnp.int32), None).compile()
+    text = compiled.as_text()
+    for shape in (f"f32[{n},{d}]", f"bf16[{n},{d}]",
+                  f"f32[{S},{m},{d}]", f"f32[{n},{n}]"):
+        if shape in text:
+            problems.append(
+                f"shardproof: {shape} tensor present in the 8-device "
+                f"hier round — a full cohort-sized array was "
+                f"rematerialized")
+    coll = compiled_cost_facts(compiled)["collective_bytes"]
+    lo, hi = S * d * 4, SHARDPROOF["coll_slack"] * S * d * 4
+    if not lo <= coll <= hi:
+        problems.append(
+            f"shardproof: collective bytes {coll} outside the O(S*d) "
+            f"pin [{lo}, {hi:.0f}] — the estimate all_gather is "
+            f"missing or a resharding collective crept in")
+
+    # (c) sharded == unsharded inside the ulp band.
+    if not problems:
+        exp_ref = _hier_experiment(
+            None, users_count=n, mal_prop=0.25, defense="Krum",
+            aggregation="hierarchical", megabatch=m)
+        for t in range(2):
+            exp8.run_round(t)
+            exp_ref.run_round(t)
+        w8 = np.asarray(exp8.state.weights)
+        wr = np.asarray(exp_ref.state.weights)
+        diff = float(np.max(np.abs(w8 - wr)))
+        if diff > SHARDPROOF["atol"]:
+            problems.append(
+                f"shardproof: sharded round diverged from the scan "
+                f"path: max|diff|={diff:.3e} > {SHARDPROOF['atol']}")
+    else:
+        diff = float("nan")
+
+    if problems:
+        print(f"FAIL perf_gate --shardproof: {len(problems)} "
+              f"violation(s)")
+        for prob in problems:
+            print(f"  {prob}")
+        return 1
+    print(f"ok   perf_gate shardproof: {len(hier_cells)} hier cells "
+          f"1-device-mesh == scan path (exact facts, 0 collective "
+          f"bytes); 8-device SPMD round @ n={n}, m={m}, d={d}: no "
+          f"(n,d)/(S,m,d)/(n,n) tensor, collective bytes {coll} "
+          f"~= S*d*4 ({S * d * 4}); sharded==unsharded to "
+          f"max|diff|={diff:.1e}")
     return 0
 
 
@@ -417,18 +587,40 @@ def main(argv=None) -> int:
     p.add_argument("--memproof", action="store_true",
                    help="additionally run the hierarchical O(m*d) "
                         "memory proof at the 10k north star, the "
-                        "secagg-vanilla wire proof and the pallas "
-                        "fusion proof (absolute structural facts, no "
-                        "baseline; ~25 s — tools/smoke.sh leg 4 runs "
-                        "all three)")
+                        "secagg-vanilla wire proof, the pallas "
+                        "fusion proof and the hierarchical SPMD "
+                        "shard proof (absolute structural facts, no "
+                        "baseline; tools/smoke.sh leg 4 runs all "
+                        "four)")
     p.add_argument("--pallasproof", action="store_true",
-                   help="run ONLY the pallas fusion proof: the fused "
+                   help="run ONLY the pallas fusion proof (+ the "
+                        "chained shard proof): the fused "
                         "distance->Krum-score kernel's operands-once "
                         "bytes must beat the XLA Gram+epilogue path "
                         "at the 10k north star and no (n, n) tensor "
                         "may exist on the pallas route (ISSUE 11)")
+    p.add_argument("--shardproof", action="store_true",
+                   help="run ONLY the hierarchical SPMD shard proof "
+                        "(ISSUE 12): every pinned hier cell on a "
+                        "1-device clients axis matches the scan "
+                        "path's exact cost facts, the 8-virtual-"
+                        "device SPMD round holds no full "
+                        "(n,d)/(S,m,d)/(n,n) tensor, its collective "
+                        "bytes pin to the O(S*d) estimate "
+                        "all_gather, and sharded==unsharded inside "
+                        "the ulp band")
     args = p.parse_args(argv)
 
+    # The shard proof needs an 8-device mesh; the flag must land
+    # before the first jax.devices() in this process (lazy backend
+    # init) — harmless for every other leg (single-device jits cost
+    # the same whatever the visible device count; the checked-in
+    # baseline is verified under both 1- and 8-device envs by
+    # tools/smoke.sh and tests/test_costs.py).
+    _ensure_virtual_devices()
+
+    if args.shardproof and not args.memproof:
+        return shardproof()
     if args.pallasproof and not args.memproof:
         return pallasproof()
 

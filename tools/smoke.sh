@@ -93,7 +93,8 @@ else
     echo "== smoke 3/11: crash_matrix — skipped (--fast) =="
 fi
 
-echo "== smoke 4/11: perf_gate (+ memproof + wireproof + pallasproof) =="
+echo "== smoke 4/11: perf_gate (+ memproof + wireproof + pallasproof"
+echo "   + shardproof) =="
 python tools/perf_gate.py --memproof || fail=1
 
 echo "== smoke 5/11: science_gate (behavioral drift) =="

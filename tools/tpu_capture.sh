@@ -61,11 +61,14 @@ if [ "$REHEARSE" = 1 ]; then
   # dedicated overnight job.
   STEP3_CELLS=()
   MB_ARGS=(--rehearse)    # pallas micro-bench: tiny shapes, interpret
+  MC_ARGS=(--rehearse)    # multichip hier: CPU + 8 virtual devices
   probe() { return 0; }
 else
   STEP2_ENV=(env FL_TEST_TPU=1)
   STEP3_CELLS=(--cells 1,2,3,4)
   MB_ARGS=()              # pallas micro-bench: Mosaic compile, 2048c
+  MC_ARGS=()              # multichip hier: live devices (a 1-chip
+                          # window banks a 'skipped' record + reason)
   probe() { relay_probe; }
 fi
 
@@ -156,6 +159,17 @@ cat "$OUT/pallas_$STAMP.jsonl"
 budget "step2.5-pallas-microbench"
 
 probe || { echo "relay died after pallas micro-bench" >&2; exit 1; }
+echo "== step 2.6: multi-chip hier round (SPMD tier-1, ISSUE 12) =="
+# First real multi-chip execution of the SPMD client_map: sharded vs
+# scan parity + walls + collective bytes, one JSON line banked either
+# way (a single-chip window records skipped+reason instead of dying).
+"${SUP[@]}" timeout 900 python tools/multichip_hier.py \
+  ${MC_ARGS[@]+"${MC_ARGS[@]}"} >"$OUT/multichip_$STAMP.jsonl" \
+  2>>"$OUT/multichip_$STAMP.log" || true
+cat "$OUT/multichip_$STAMP.jsonl"
+budget "step2.6-multichip-hier"
+
+probe || { echo "relay died after multichip hier" >&2; exit 1; }
 echo "== step 3: BASELINE cells =="
 "${SUP[@]}" timeout 7200 python -m attacking_federate_learning_tpu.benchmarks \
   --rounds 10 ${STEP3_CELLS[@]+"${STEP3_CELLS[@]}"} 2>&1 \
