@@ -255,13 +255,19 @@ def async_step(grads, t, key, spec: AsyncSpec, state, m_mal,
     pocc = state["pocc"] | arr_occ
 
     # --- age, evict over-stale, quarantine non-finite ------------------
-    stal = jnp.asarray(t, jnp.int32) - pbirth            # (m,)
-    over = pocc & (stal > spec.max_staleness)
-    evicted = jnp.sum(over).astype(jnp.int32)
-    pocc = pocc & ~over
-    finite = jnp.isfinite(pbuf).all(axis=1)
-    quarantined = jnp.sum(pocc & ~finite).astype(jnp.int32)
-    pocc = pocc & finite
+    # Stage ledger (utils/costs.py): the server-side screen on pending
+    # rows is the ``quarantine`` stage (the ring mechanics around it
+    # stay 'deliver', the engine's call-site scope).
+    from attacking_federate_learning_tpu.utils.costs import stage_scope
+
+    with stage_scope("quarantine"):
+        stal = jnp.asarray(t, jnp.int32) - pbirth        # (m,)
+        over = pocc & (stal > spec.max_staleness)
+        evicted = jnp.sum(over).astype(jnp.int32)
+        pocc = pocc & ~over
+        finite = jnp.isfinite(pbuf).all(axis=1)
+        quarantined = jnp.sum(pocc & ~finite).astype(jnp.int32)
+        pocc = pocc & finite
 
     # --- FedBuff trigger: consume the k oldest pending (FIFO) only
     # once k are available; otherwise hold (server no-op round) -------

@@ -58,7 +58,9 @@ from attacking_federate_learning_tpu.data.partition import (
 from attacking_federate_learning_tpu.defenses import (
     DEFENSES, check_defense_args
 )
+from attacking_federate_learning_tpu.defenses.kernels import stage_wrapped
 from attacking_federate_learning_tpu.models.base import get_model
+from attacking_federate_learning_tpu.utils.costs import stage_scope
 from attacking_federate_learning_tpu.utils.flatten import make_flattener
 from attacking_federate_learning_tpu.utils.metrics import RunLogger
 
@@ -203,6 +205,11 @@ class FederatedExperiment:
             self.defense_fn = functools.partial(
                 self.defense_fn, tau=cfg.cclip_tau,
                 iters=cfg.cclip_iters)
+        # Stage ledger (utils/costs.py): every op the tier-1 kernel
+        # traces carries 'tier1_aggregate' metadata whatever the call
+        # site (fused round, hier shard_fn, standalone cost entries).
+        self.defense_fn = stage_wrapped(self.defense_fn,
+                                        "tier1_aggregate")
 
         key = jax.random.key(cfg.seed)
         k_init, self.key_run = jax.random.split(key)
@@ -411,7 +418,10 @@ class FederatedExperiment:
         # Same validity bounds per tier that the flat path checks once.
         check_tier2_args(cfg.defense, cfg.megabatch, self._tier1_f)
         check_tier2_args(self._tier2_name, S, self._tier2_f)
-        self._tier2_fn = TIER2_DEFENSES[self._tier2_name]
+        # Stage ledger: the tier-2 shard reduction carries its own
+        # taxonomy stage, distinct from the per-shard tier-1 kernel.
+        self._tier2_fn = stage_wrapped(TIER2_DEFENSES[self._tier2_name],
+                                       "tier2_aggregate")
 
     # ------------------------------------------------------------------
     def _init_async(self):
@@ -670,36 +680,45 @@ class FederatedExperiment:
     def _compute_grads_impl(self, state: ServerState, t, batches=None):
         """batches=None gathers from the device-resident dataset; the
         host-streaming mode (cfg.data_placement='host_stream') passes the
-        round's pre-transferred (xs, ys) instead."""
+        round's pre-transferred (xs, ys) instead.
+
+        Stage ledger: everything here is the ``deliver`` stage — batch
+        delivery + client update, the cohort's gradients arriving at
+        tier 1 (utils/costs.py:STAGES; metadata-only annotation)."""
         cfg = self.cfg
-        if batches is None:
-            part = self._participants(t)
-            xs, ys = self._gather_batches(t, part)
-        else:
-            xs, ys = batches
-            # The streaming prefetcher derives the identical cohort ids
-            # (platform-invariant RNG, _participants_host), so re-deriving
-            # here keeps the style rows aligned with the streamed batch.
-            part = (self._participants(t) if self._style is not None
-                    else None)
-        xs = self._apply_style(xs, part)
-        xs = self._maybe_augment(xs, t)
-        # Split the flat (m, k*B) gather into k local-step minibatches.
-        k, B = cfg.local_steps, cfg.batch_size
-        xs = xs.reshape((self.m, k, B) + xs.shape[2:])
-        ys = ys.reshape((self.m, k, B))
-        # Clients train at the faded lr the server dispatches (reference
-        # server.py:50-52; inert at k=1, user.py:80); the pseudo-gradient
-        # divides by the lr the server will multiply back in so the
-        # FedAvg reduction is exact under the constant-server-lr quirk.
-        lr_train = faded_learning_rate(cfg.learning_rate, cfg.fading_rate, t)
-        lr_report = (lr_train if cfg.server_uses_faded_lr
-                     else cfg.learning_rate)
-        grads = self._client_update(state.weights, xs, ys, lr_train,
-                                    lr_report)
-        grads = grads.astype(self._grad_dtype)  # bf16 halves HBM at scale
-        if self.shardings is not None:
-            grads = self.shardings.constrain_grads(grads)
+        with stage_scope("deliver"):
+            if batches is None:
+                part = self._participants(t)
+                xs, ys = self._gather_batches(t, part)
+            else:
+                xs, ys = batches
+                # The streaming prefetcher derives the identical cohort
+                # ids (platform-invariant RNG, _participants_host), so
+                # re-deriving here keeps the style rows aligned with the
+                # streamed batch.
+                part = (self._participants(t) if self._style is not None
+                        else None)
+            xs = self._apply_style(xs, part)
+            xs = self._maybe_augment(xs, t)
+            # Split the flat (m, k*B) gather into k local-step
+            # minibatches.
+            k, B = cfg.local_steps, cfg.batch_size
+            xs = xs.reshape((self.m, k, B) + xs.shape[2:])
+            ys = ys.reshape((self.m, k, B))
+            # Clients train at the faded lr the server dispatches
+            # (reference server.py:50-52; inert at k=1, user.py:80); the
+            # pseudo-gradient divides by the lr the server will multiply
+            # back in so the FedAvg reduction is exact under the
+            # constant-server-lr quirk.
+            lr_train = faded_learning_rate(cfg.learning_rate,
+                                           cfg.fading_rate, t)
+            lr_report = (lr_train if cfg.server_uses_faded_lr
+                         else cfg.learning_rate)
+            grads = self._client_update(state.weights, xs, ys, lr_train,
+                                        lr_report)
+            grads = grads.astype(self._grad_dtype)  # bf16 halves HBM
+            if self.shardings is not None:
+                grads = self.shardings.constrain_grads(grads)
         return grads
 
     def _aggregate_impl(self, state: ServerState, grads, t, agg=None,
@@ -716,33 +735,40 @@ class FederatedExperiment:
         (core/async_rounds.py; requires ``mask``)."""
         ddiag = {}
         if agg is None:
-            kw = {}
-            if mask is not None:
-                kw["mask"] = mask
-            if weights is not None:
-                kw["weights"] = weights
-            if getattr(self.defense_fn, "needs_round", False):
-                # Round-seeded defenses (DnC's fresh sketches) — the same
-                # attribute seam FLTrust uses for needs_server_grad.
-                kw["round"] = t
-            if self._needs_server_grad:
-                server_grad = jax.grad(make_loss_fn(self.model, self.flat))(
-                    state.weights, self._meta_x, self._meta_y)
-                kw["server_grad"] = server_grad
-            if telemetry:
-                agg, ddiag = self.defense_fn(grads, self.m, self.m_mal,
-                                             telemetry=True, **kw)
+            # Stage ledger: the defense kernel (server_grad included —
+            # FLTrust's trust anchor is part of the tier-1 decision) is
+            # the ``tier1_aggregate`` stage.
+            with stage_scope("tier1_aggregate"):
+                kw = {}
+                if mask is not None:
+                    kw["mask"] = mask
+                if weights is not None:
+                    kw["weights"] = weights
+                if getattr(self.defense_fn, "needs_round", False):
+                    # Round-seeded defenses (DnC's fresh sketches) — the
+                    # same attribute seam FLTrust uses for
+                    # needs_server_grad.
+                    kw["round"] = t
+                if self._needs_server_grad:
+                    server_grad = jax.grad(
+                        make_loss_fn(self.model, self.flat))(
+                        state.weights, self._meta_x, self._meta_y)
+                    kw["server_grad"] = server_grad
+                if telemetry:
+                    agg, ddiag = self.defense_fn(
+                        grads, self.m, self.m_mal, telemetry=True, **kw)
+                else:
+                    agg = self.defense_fn(grads, self.m, self.m_mal, **kw)
+        with stage_scope("apply"):
+            agg = agg.astype(jnp.float32)
+            if self.cfg.server_uses_faded_lr:
+                lr = faded_learning_rate(self.cfg.learning_rate,
+                                         self.cfg.fading_rate, t)
             else:
-                agg = self.defense_fn(grads, self.m, self.m_mal, **kw)
-        agg = agg.astype(jnp.float32)
-        if self.cfg.server_uses_faded_lr:
-            lr = faded_learning_rate(self.cfg.learning_rate,
-                                     self.cfg.fading_rate, t)
-        else:
-            # Reference parity: constant base lr on the server
-            # (server.py:89, SURVEY.md §2.4 #7).
-            lr = self.cfg.learning_rate
-        new_state = momentum_update(state, agg, lr, self.cfg.momentum)
+                # Reference parity: constant base lr on the server
+                # (server.py:89, SURVEY.md §2.4 #7).
+                lr = self.cfg.learning_rate
+            new_state = momentum_update(state, agg, lr, self.cfg.momentum)
         if telemetry:
             return new_state, ddiag
         return new_state
@@ -768,21 +794,23 @@ class FederatedExperiment:
             norm spread, aggregate step norm, faded lr — plus, under Krum,
             which client won selection and whether it was malicious (the
             selection-histogram observability the reference lacks; ``aux``
-            carries the selection the defense actually made)."""
-            norms = jnp.linalg.norm(grads.astype(jnp.float32), axis=1)
-            diag = {
-                "grad_norm_mean": jnp.mean(norms),
-                "grad_norm_max": jnp.max(norms),
-                "grad_norm_min": jnp.min(norms),
-                "update_norm": jnp.linalg.norm(state_after.velocity),
-                "faded_lr": faded_learning_rate(cfg.learning_rate,
-                                                cfg.fading_rate, t),
-            }
-            if aux and "krum_selected" in aux:
-                sel = aux["krum_selected"]
-                diag["krum_selected"] = sel
-                diag["malicious_selected"] = (sel < self.m_mal).astype(
-                    jnp.int32)
+            carries the selection the defense actually made).  Stage
+            ledger: these riders observe the applied update — ``apply``."""
+            with stage_scope("apply"):
+                norms = jnp.linalg.norm(grads.astype(jnp.float32), axis=1)
+                diag = {
+                    "grad_norm_mean": jnp.mean(norms),
+                    "grad_norm_max": jnp.max(norms),
+                    "grad_norm_min": jnp.min(norms),
+                    "update_norm": jnp.linalg.norm(state_after.velocity),
+                    "faded_lr": faded_learning_rate(cfg.learning_rate,
+                                                    cfg.fading_rate, t),
+                }
+                if aux and "krum_selected" in aux:
+                    sel = aux["krum_selected"]
+                    diag["krum_selected"] = sel
+                    diag["malicious_selected"] = (sel < self.m_mal).astype(
+                        jnp.int32)
             return diag
 
         self._round_diagnostics = round_diagnostics
@@ -825,30 +853,35 @@ class FederatedExperiment:
             from attacking_federate_learning_tpu.core.faults import (
                 apply_faults, quarantine
             )
-            submitted, dropped, fstate2, fstats = apply_faults(
-                grads, t, self._fault_key, fstate, self.faults,
-                self.m_mal)
-            clean, mask, qstats = quarantine(submitted, dropped)
+            with stage_scope("quarantine"):
+                submitted, dropped, fstate2, fstats = apply_faults(
+                    grads, t, self._fault_key, fstate, self.faults,
+                    self.m_mal)
+                clean, mask, qstats = quarantine(submitted, dropped)
             return clean, mask, fstate2, {**fstats, **qstats}
 
         self._inject_and_quarantine = inject_and_quarantine
 
         def attack_envelope(grads, state, t):
             """Pre-attack envelope stats (attacks/base.py seam), keyed
-            ``attack_*`` into the telemetry pytree."""
-            stats = self.attacker.envelope_stats(grads, self.m_mal,
-                                                 ctx_for(state, t))
+            ``attack_*`` into the telemetry pytree.  Stage ledger:
+            observes the delivered/crafted matrix — ``deliver``."""
+            with stage_scope("deliver"):
+                stats = self.attacker.envelope_stats(grads, self.m_mal,
+                                                     ctx_for(state, t))
             return {"attack_" + k: v for k, v in stats.items()}
 
         def finish_telemetry(tele, grads, ddiag):
             """Merge defense diagnostics + population stats into the
-            round's telemetry pytree (all fixed-shape device arrays)."""
+            round's telemetry pytree (all fixed-shape device arrays).
+            Stage ledger: defense forensics — ``tier1_aggregate``."""
             from attacking_federate_learning_tpu.defenses.kernels import (
                 population_telemetry
             )
-            for k, v in ddiag.items():
-                tele["defense_" + k] = v
-            tele.update(population_telemetry(grads))
+            with stage_scope("tier1_aggregate"):
+                for k, v in ddiag.items():
+                    tele["defense_" + k] = v
+                tele.update(population_telemetry(grads))
             return tele
 
         if self._secagg is not None:
@@ -875,8 +908,11 @@ class FederatedExperiment:
                 grads = self._compute_grads_impl(state, t, batches)
                 tele = (attack_envelope(grads, state, t) if cfg.telemetry
                         else {})
-                grads = self.attacker.apply(grads, self.m_mal,
-                                            ctx_for(state, t))
+                with stage_scope("deliver"):
+                    # Attack craft happens on the wire: what tier 1
+                    # receives IS the crafted matrix.
+                    grads = self.attacker.apply(grads, self.m_mal,
+                                                ctx_for(state, t))
                 # ``grads`` stays the post-attack, PRE-fault matrix from
                 # here on (the nan guard must see what the attacker
                 # crafted — a dropout zeroing a malicious row must not
@@ -913,8 +949,9 @@ class FederatedExperiment:
                 return new_state, grads, aux, tele, fstate
 
             def crafted_nonfinite(grads):
-                return (~jnp.isfinite(
-                    grads[: self.m_mal].astype(jnp.float32))).any()
+                with stage_scope("quarantine"):   # the fused nan guard
+                    return (~jnp.isfinite(
+                        grads[: self.m_mal].astype(jnp.float32))).any()
 
             if self.faults is None:
                 def fused(state, t, batches=None):
@@ -1149,33 +1186,37 @@ class FederatedExperiment:
             kernel's telemetry on THIS shard's sub-matrix, stacked by
             client_map into the (S, ...) shard_selection record) and,
             in the clear modes, the per-row gradient norms."""
-            shard_rows = self.shards[ids]
-            idx = round_batch_indices(
-                shard_rows, t, cfg.batch_size * cfg.local_steps)
-            xs, ys = self.train_x[idx], self.train_y[idx]
-            xs = self._apply_style(xs, ids)
-            xs = self._maybe_augment(xs, t)
-            k, B = cfg.local_steps, cfg.batch_size
-            xs = xs.reshape((m, k, B) + xs.shape[2:])
-            ys = ys.reshape((m, k, B))
-            lr_train = faded_learning_rate(cfg.learning_rate,
-                                           cfg.fading_rate, t)
-            lr_report = (lr_train if cfg.server_uses_faded_lr
-                         else cfg.learning_rate)
-            grads = self._client_update(state.weights, xs, ys, lr_train,
-                                        lr_report)
-            grads = grads.astype(self._grad_dtype)
-            if self.shardings is not None and not self._hier_spmd:
-                # Under the SPMD client_map the body is device-local
-                # code inside shard_map — a global sharding constraint
-                # has no meaning there (the megabatch grid IS the
-                # sharded operand).
-                grads = self.shardings.constrain_grads(grads)
-            grads = self.attacker.apply(grads, c_mal, ctx_for(state, t))
-            bad = (
-                (~jnp.isfinite(grads[:c_mal].astype(jnp.float32))).any()
-                if (self._check_attack_nan and c_mal > 0)
-                else jnp.asarray(False))
+            with stage_scope("deliver"):
+                shard_rows = self.shards[ids]
+                idx = round_batch_indices(
+                    shard_rows, t, cfg.batch_size * cfg.local_steps)
+                xs, ys = self.train_x[idx], self.train_y[idx]
+                xs = self._apply_style(xs, ids)
+                xs = self._maybe_augment(xs, t)
+                k, B = cfg.local_steps, cfg.batch_size
+                xs = xs.reshape((m, k, B) + xs.shape[2:])
+                ys = ys.reshape((m, k, B))
+                lr_train = faded_learning_rate(cfg.learning_rate,
+                                               cfg.fading_rate, t)
+                lr_report = (lr_train if cfg.server_uses_faded_lr
+                             else cfg.learning_rate)
+                grads = self._client_update(state.weights, xs, ys,
+                                            lr_train, lr_report)
+                grads = grads.astype(self._grad_dtype)
+                if self.shardings is not None and not self._hier_spmd:
+                    # Under the SPMD client_map the body is device-local
+                    # code inside shard_map — a global sharding
+                    # constraint has no meaning there (the megabatch
+                    # grid IS the sharded operand).
+                    grads = self.shardings.constrain_grads(grads)
+                grads = self.attacker.apply(grads, c_mal,
+                                            ctx_for(state, t))
+            with stage_scope("quarantine"):   # the fused nan guard
+                bad = (
+                    (~jnp.isfinite(
+                        grads[:c_mal].astype(jnp.float32))).any()
+                    if (self._check_attack_nan and c_mal > 0)
+                    else jnp.asarray(False))
             if groupwise:
                 # NET-SA composition: the group's rows are secure-
                 # aggregated (masks keyed on these GLOBAL client ids,
@@ -1214,8 +1255,9 @@ class FederatedExperiment:
                 est = self.defense_fn(grads, m, f1)
             out["est"] = est.astype(jnp.float32)
             if want_norms:
-                out["norms"] = jnp.linalg.norm(
-                    grads.astype(jnp.float32), axis=1)
+                with stage_scope("deliver"):   # delivered-matrix rider
+                    out["norms"] = jnp.linalg.norm(
+                        grads.astype(jnp.float32), axis=1)
             return out
 
         # SPMD: client_map runs the shard_map mapping (each device owns
@@ -1228,7 +1270,12 @@ class FederatedExperiment:
 
         def hier_core(state, t):
             tele = {}
-            out = client_map(shard_fn, place, state, t, plan=cm_plan)
+            # Outer scope: the megabatch scan's own plumbing (carry
+            # writes, estimate stacking) books under tier1_aggregate;
+            # the finer scopes inside shard_fn win for everything they
+            # annotate (stage_attribution takes the innermost token).
+            with stage_scope("tier1_aggregate"):
+                out = client_map(shard_fn, place, state, t, plan=cm_plan)
             norms = diag1 = sum_oks = None
             if extras:
                 ests, bads = out["est"], out["bad"]
@@ -1242,25 +1289,28 @@ class FederatedExperiment:
             if groupwise:
                 # Per-group sum norms are server-visible under
                 # group-wise secagg (each estimate is sum/m): the v5
-                # 'secagg' event's observable quantity.
-                tele = {
-                    "secagg_sum_check_ok":
-                        jnp.all(sum_oks > 0).astype(jnp.int32),
-                    "secagg_groups": jnp.asarray(S, jnp.int32),
-                    "secagg_dropped": jnp.zeros((), jnp.int32),
-                    "secagg_masks_reconstructed":
-                        jnp.zeros((), jnp.int32),
-                    "secagg_recovery": jnp.zeros((), jnp.int32),
-                    "secagg_group_sum_norms":
-                        jnp.linalg.norm(ests, axis=1) * m,
-                }
-                if tele_on:
-                    # Group-sum envelope (protocols/secagg.py): the
-                    # population view the server can still compute
-                    # when groups, not clients, are the visible unit.
-                    env = group_envelope_stats(ests, m)
-                    tele["secagg_group_cos_to_mean"] = (
-                        env["group_cos_to_mean"])
+                # 'secagg' event's observable quantity.  Stage ledger:
+                # protocol-side riders — ``protect``.
+                with stage_scope("protect"):
+                    tele = {
+                        "secagg_sum_check_ok":
+                            jnp.all(sum_oks > 0).astype(jnp.int32),
+                        "secagg_groups": jnp.asarray(S, jnp.int32),
+                        "secagg_dropped": jnp.zeros((), jnp.int32),
+                        "secagg_masks_reconstructed":
+                            jnp.zeros((), jnp.int32),
+                        "secagg_recovery": jnp.zeros((), jnp.int32),
+                        "secagg_group_sum_norms":
+                            jnp.linalg.norm(ests, axis=1) * m,
+                    }
+                    if tele_on:
+                        # Group-sum envelope (protocols/secagg.py): the
+                        # population view the server can still compute
+                        # when groups, not clients, are the visible
+                        # unit.
+                        env = group_envelope_stats(ests, m)
+                        tele["secagg_group_cos_to_mean"] = (
+                            env["group_cos_to_mean"])
             if tele_on:
                 if diag1:
                     for dk, dv in diag1.items():
@@ -1270,10 +1320,11 @@ class FederatedExperiment:
                 agg, diag2 = shard_reduce(tier2_fn, ests, S, f2,
                                           plan=t2_plan,
                                           telemetry=True)
-                for dk, dv in diag2.items():
-                    tele["tier2_" + dk] = dv
-                tele["tier2_est_norms"] = jnp.linalg.norm(
-                    ests.astype(jnp.float32), axis=1)
+                with stage_scope("tier2_aggregate"):
+                    for dk, dv in diag2.items():
+                        tele["tier2_" + dk] = dv
+                    tele["tier2_est_norms"] = jnp.linalg.norm(
+                        ests.astype(jnp.float32), axis=1)
             else:
                 agg = shard_reduce(tier2_fn, ests, S, f2,
                                    plan=t2_plan)
@@ -1286,23 +1337,25 @@ class FederatedExperiment:
                 # mode can observe: exact per-client norm stats in the
                 # clear modes (the (S, m) stack holds the same n
                 # values), group-sum norm stats under groupwise.
-                diag = {
-                    "update_norm": jnp.linalg.norm(new_state.velocity),
-                    "faded_lr": faded_learning_rate(
-                        cfg.learning_rate, cfg.fading_rate, t),
-                }
-                if norms is not None:
-                    diag.update(
-                        grad_norm_mean=jnp.mean(norms),
-                        grad_norm_max=jnp.max(norms),
-                        grad_norm_min=jnp.min(norms))
-                else:
-                    gs = jnp.linalg.norm(
-                        ests.astype(jnp.float32), axis=1) * m
-                    diag.update(
-                        group_sum_norm_mean=jnp.mean(gs),
-                        group_sum_norm_max=jnp.max(gs),
-                        group_sum_norm_min=jnp.min(gs))
+                with stage_scope("apply"):
+                    diag = {
+                        "update_norm": jnp.linalg.norm(
+                            new_state.velocity),
+                        "faded_lr": faded_learning_rate(
+                            cfg.learning_rate, cfg.fading_rate, t),
+                    }
+                    if norms is not None:
+                        diag.update(
+                            grad_norm_mean=jnp.mean(norms),
+                            grad_norm_max=jnp.max(norms),
+                            grad_norm_min=jnp.min(norms))
+                    else:
+                        gs = jnp.linalg.norm(
+                            ests.astype(jnp.float32), axis=1) * m
+                        diag.update(
+                            group_sum_norm_mean=jnp.mean(gs),
+                            group_sum_norm_max=jnp.max(gs),
+                            group_sum_norm_min=jnp.min(gs))
             return new_state, diag, bad, tele
 
         def fused(state, t, batches=None):
@@ -1418,69 +1471,83 @@ class FederatedExperiment:
 
         def async_core(state, t, astate):
             grads = self._compute_grads_impl(state, t)
-            (delivered_grads, delivered, staleness, astate,
-             stats) = async_step(
-                grads, t, self._async_key, spec, astate, self.m_mal,
-                faults=self.faults,
-                fkey=self._fault_key if self.faults is not None
-                else None)
+            # Stage ledger: the delivery ring (submit/merge/evict/
+            # deliver) is how updates ARRIVE — ``deliver``.
+            with stage_scope("deliver"):
+                (delivered_grads, delivered, staleness, astate,
+                 stats) = async_step(
+                    grads, t, self._async_key, spec, astate, self.m_mal,
+                    faults=self.faults,
+                    fkey=self._fault_key if self.faults is not None
+                    else None)
             ctx = ctx_for(state, t, staleness)
             tele = dict(stats)
             if cfg.telemetry:
-                env = self.attacker.envelope_stats(delivered_grads,
-                                                   self.m_mal, ctx)
+                with stage_scope("deliver"):
+                    env = self.attacker.envelope_stats(delivered_grads,
+                                                       self.m_mal, ctx)
                 tele.update({"attack_" + k: v for k, v in env.items()})
-            # Attack at delivery; undelivered rows [0, f) get
-            # overwritten too, so re-mask before aggregation (the
-            # quarantine zero convention — distance engines NaN-free).
-            crafted = self.attacker.apply(delivered_grads, self.m_mal,
-                                          ctx)
+            with stage_scope("deliver"):
+                # Attack at delivery; undelivered rows [0, f) get
+                # overwritten too, so re-mask before aggregation (the
+                # quarantine zero convention — distance engines
+                # NaN-free).
+                crafted = self.attacker.apply(delivered_grads,
+                                              self.m_mal, ctx)
             bad = (crafted_nonfinite(crafted)
                    if self._check_attack_nan else jnp.asarray(False))
-            agg_grads = jnp.where(delivered[:, None], crafted, 0.0)
-            weights = staleness_weights(staleness, delivered,
-                                        spec.weighting)
-            # Weight mass by staleness bucket — the science surface
-            # ('async' events; weighting='none' reports unit weights).
-            w_eff = (weights if weights is not None
-                     else jnp.where(delivered, 1.0, 0.0))
-            bucket = staleness[None, :] == jnp.arange(D)[:, None]
-            tele["async_weight_mass"] = jnp.sum(
-                bucket * w_eff[None, :], axis=1).astype(jnp.float32)
+            with stage_scope("quarantine"):
+                agg_grads = jnp.where(delivered[:, None], crafted, 0.0)
+            with stage_scope("deliver"):
+                weights = staleness_weights(staleness, delivered,
+                                            spec.weighting)
+                # Weight mass by staleness bucket — the science surface
+                # ('async' events; weighting='none' reports unit
+                # weights).
+                w_eff = (weights if weights is not None
+                         else jnp.where(delivered, 1.0, 0.0))
+                bucket = staleness[None, :] == jnp.arange(D)[:, None]
+                tele["async_weight_mass"] = jnp.sum(
+                    bucket * w_eff[None, :], axis=1).astype(jnp.float32)
             if cfg.telemetry:
                 upd, ddiag = self._aggregate_impl(
                     state, agg_grads, t, telemetry=True, mask=delivered,
                     weights=weights)
-                for dk, dv in ddiag.items():
-                    tele["defense_" + dk] = dv
-                tele.update(population_telemetry(agg_grads))
+                with stage_scope("tier1_aggregate"):
+                    for dk, dv in ddiag.items():
+                        tele["defense_" + dk] = dv
+                    tele.update(population_telemetry(agg_grads))
             else:
                 upd = self._aggregate_impl(state, agg_grads, t,
                                            mask=delivered,
                                            weights=weights)
-            # Empty delivery = server no-op (weights/velocity hold,
-            # the round counter still advances).
-            any_del = jnp.any(delivered)
-            new_state = ServerState(
-                weights=jnp.where(any_del, upd.weights, state.weights),
-                velocity=jnp.where(any_del, upd.velocity,
-                                   state.velocity),
-                round=upd.round)
+            with stage_scope("apply"):
+                # Empty delivery = server no-op (weights/velocity hold,
+                # the round counter still advances).
+                any_del = jnp.any(delivered)
+                new_state = ServerState(
+                    weights=jnp.where(any_del, upd.weights,
+                                      state.weights),
+                    velocity=jnp.where(any_del, upd.velocity,
+                                       state.velocity),
+                    round=upd.round)
             diag = {}
             if cfg.log_round_stats:
                 # Norm stats over the COMPUTED cohort (what clients
                 # submitted this round — comparable to the flat
                 # fields); the delivered view lives in async_* stats.
-                norms = jnp.linalg.norm(grads.astype(jnp.float32),
-                                        axis=1)
-                diag = {
-                    "grad_norm_mean": jnp.mean(norms),
-                    "grad_norm_max": jnp.max(norms),
-                    "grad_norm_min": jnp.min(norms),
-                    "update_norm": jnp.linalg.norm(new_state.velocity),
-                    "faded_lr": faded_learning_rate(
-                        cfg.learning_rate, cfg.fading_rate, t),
-                }
+                with stage_scope("apply"):
+                    norms = jnp.linalg.norm(grads.astype(jnp.float32),
+                                            axis=1)
+                    diag = {
+                        "grad_norm_mean": jnp.mean(norms),
+                        "grad_norm_max": jnp.max(norms),
+                        "grad_norm_min": jnp.min(norms),
+                        "update_norm": jnp.linalg.norm(
+                            new_state.velocity),
+                        "faded_lr": faded_learning_rate(
+                            cfg.learning_rate, cfg.fading_rate, t),
+                    }
             return new_state, diag, bad, tele, astate
 
         def fused(state, t, astate, batches=None):
@@ -1511,6 +1578,50 @@ class FederatedExperiment:
         self._fused_round = jax.jit(fused)
         self._async_span = jax.jit(async_span, static_argnums=2)
         self._staged = False
+
+    # ------------------------------------------------------------------
+    def wire_ledger(self):
+        """Per-seam wire ledger for THIS engine's topology
+        (utils/costs.py:wire_ledger): the bytes each logical network
+        seam moves per round, derived statically from the config — no
+        execution, no HLO.  Seams that the topology doesn't exercise
+        carry 0 bytes, so one schema covers flat, hierarchical and
+        async runs (and their secagg compositions) uniformly.
+
+        The hierarchical tier1_to_tier2 seam doubles as the SPMD
+        cross-check: under a >1-device clients axis it equals the
+        measured all_gather ``collective_bytes`` that
+        tools/perf_gate.py --shardproof pins to S*d*4 (ISSUE 12)."""
+        cfg = self.cfg
+        spmd_parts = 1
+        num_shards = None
+        if cfg.aggregation == "hierarchical":
+            num_shards = self._placement.num_shards
+            if self._hier_spmd:
+                from attacking_federate_learning_tpu.parallel.mesh import (
+                    CLIENTS
+                )
+                spmd_parts = int(self.shardings.mesh.shape[CLIENTS])
+        dropped = 0
+        if cfg.secagg != "off" and self.faults is not None:
+            # Expected mask-reconstruction load: the dropout fault rate
+            # over the cohort (secagg only composes with dropout faults,
+            # config.py enforces).
+            dropped = int(round(self.faults.dropout * self.m))
+        from attacking_federate_learning_tpu.utils.costs import wire_ledger
+        return wire_ledger(
+            cohort=self.m,
+            dim=self.flat.dim,
+            grad_bytes=self._grad_dtype.itemsize,
+            topology=cfg.aggregation,
+            num_shards=num_shards,
+            megabatch=cfg.megabatch if num_shards is not None else None,
+            spmd_parts=spmd_parts,
+            secagg=cfg.secagg,
+            dropped=dropped,
+            async_buffer=(cfg.async_buffer
+                          if cfg.aggregation == "async" else None),
+        )
 
     # ------------------------------------------------------------------
     def cost_report(self, logger=None, span: Optional[int] = None):
@@ -1654,6 +1765,12 @@ class FederatedExperiment:
             except Exception as e:        # noqa: BLE001 — one entry
                 # failing to lower must not lose the rest of the table
                 ledger.errors.append((name, f"{type(e).__name__}: {e}"))
+        # Wire ledger rides the same report: one versioned wire_bytes
+        # event per cost_report, next to the per-entry stage_cost rows.
+        try:
+            ledger.wire = self.wire_ledger()
+        except Exception:             # noqa: BLE001 — observability
+            ledger.wire = None        # must never sink a run
         if logger is not None:
             ledger.emit(logger)
         self.cost_ledger = ledger

@@ -50,10 +50,34 @@ import numpy as np
 from jax import lax
 
 from attacking_federate_learning_tpu.ops.distances import pairwise_distances
+from attacking_federate_learning_tpu.utils.costs import stage_scope
 from attacking_federate_learning_tpu.utils.plugins import Registry
 
 
 DEFENSES = Registry("defense")
+
+
+def stage_wrapped(fn, stage):
+    """Defense-kernel dispatch seam of the stage ledger (utils/costs.py):
+    every op a kernel traces carries ``stage`` in its op_name metadata,
+    whatever call site invoked it (fused round, hier shard_fn, the
+    standalone ``defense_<name>``/``tier2_<name>`` cost-report entries).
+    Attribute-transparent: ``needs_round``/``needs_server_grad``/etc.
+    survive the wrap — functools.wraps copies ``__dict__`` (where they
+    live on both plain kernels and the engine's partials) and tolerates
+    partials' missing ``__name__``."""
+    @functools.wraps(fn)
+    def scoped(*args, **kwargs):
+        with stage_scope(stage):
+            return fn(*args, **kwargs)
+
+    # Partial introspection (tests reach exp.defense_fn.keywords to pin
+    # config wiring) rides through: partial's C-level attrs are not in
+    # __dict__, so wraps alone would drop them.
+    for attr in ("func", "args", "keywords"):
+        if hasattr(fn, attr) and not hasattr(scoped, attr):
+            setattr(scoped, attr, getattr(fn, attr))
+    return scoped
 
 _INF = jnp.inf
 # topk cancellation guard: required ratio of a row's kept score mass to

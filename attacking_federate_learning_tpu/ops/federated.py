@@ -303,12 +303,20 @@ def shard_reduce(tier2_fn, estimates, num_shards: int,
     shard_* entry) additionally returns the tier-2 diagnostics pytree
     — (S,)-shaped selection masks/scores over the SHARD axis, the
     which-estimates-were-rejected record the forensics layer
-    attributes colluder placement from (report.py)."""
-    estimates = estimates.astype(jnp.float32)
-    if plan is not None:
-        estimates = plan.constrain_estimates(estimates)
-    return tier2_fn(estimates, num_shards, corrupted_shards,
-                    alive_counts=alive_counts, **kw)
+    attributes colluder placement from (report.py).
+
+    Stage ledger (utils/costs.py): the reduction — resharding
+    constraint included — is the ``tier2_aggregate`` stage, whatever
+    ``tier2_fn`` the caller passes (the engine's dispatch wrap covers
+    its own; raw kernels from tests/bench get it here)."""
+    from attacking_federate_learning_tpu.utils.costs import stage_scope
+
+    with stage_scope("tier2_aggregate"):
+        estimates = estimates.astype(jnp.float32)
+        if plan is not None:
+            estimates = plan.constrain_estimates(estimates)
+        return tier2_fn(estimates, num_shards, corrupted_shards,
+                        alive_counts=alive_counts, **kw)
 
 
 def two_tier_aggregate(users_grads, placement: Placement, tier1_fn,

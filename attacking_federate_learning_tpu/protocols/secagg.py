@@ -219,14 +219,22 @@ def secagg_cohort(grads, alive, key, t, ids=None):
     engine's full-participation identity).  Returns
     ``(recovered, stats)``; ``recovered`` is bit-identical to the
     clear matrix with dropped rows zeroed, so the downstream
-    aggregation is byte-for-byte the clear computation's."""
+    aggregation is byte-for-byte the clear computation's.
+
+    Stage ledger (utils/costs.py): the whole protocol — mask
+    derivation, wire masking, server-side recovery — is the
+    ``protect`` stage, for every caller (flat secagg_step, groupwise
+    :func:`secagg_group`)."""
+    from attacking_federate_learning_tpu.utils.costs import stage_scope
+
     n, d = grads.shape
-    if ids is None:
-        ids = jnp.arange(n, dtype=jnp.int32)
-    key_t = jax.random.fold_in(key, t)
-    deltas = pairwise_deltas(key_t, ids, d)
-    wire = mask_rows(grads, deltas)
-    return unmask_sum(wire, deltas, grads, alive, key_t, ids)
+    with stage_scope("protect"):
+        if ids is None:
+            ids = jnp.arange(n, dtype=jnp.int32)
+        key_t = jax.random.fold_in(key, t)
+        deltas = pairwise_deltas(key_t, ids, d)
+        wire = mask_rows(grads, deltas)
+        return unmask_sum(wire, deltas, grads, alive, key_t, ids)
 
 
 def secagg_group(grads, key, t, ids):

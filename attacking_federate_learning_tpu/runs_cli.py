@@ -30,6 +30,12 @@ the experiment flag surface stays reference-verbatim).  Verbs:
   manifests bit-exactly, and skipped cells show their composition-
   rejection reason.  Refreshes the registry first (campaign cells
   finish out-of-band, so a cold index would lie)
+- ``runs attribution Q [B]`` — per-stage cost table (the ISSUE-15
+  taxonomy: deliver/quarantine/protect/tier1_aggregate/
+  tier2_aggregate/apply) and per-seam wire-bytes table from a run's
+  schema-v9 ``stage_cost``/``wire_bytes`` events (any --cost-report
+  run carries them; campaign cells do automatically).  A second query
+  renders the two runs' stage/seam diff instead
 - ``runs selfcheck``    — CI leg: refresh idempotence + resolvability
   over the current run store (tools/smoke.sh leg 6)
 
@@ -464,6 +470,119 @@ def cmd_campaign(reg, args):
     return 0
 
 
+def _attribution_data(events):
+    """The run's v9 observability payloads: {entry: stage_cost event}
+    (last writer wins — one cost_report per run in practice) plus the
+    run's wire_bytes event, or None when the run predates schema v9 /
+    ran without --cost-report."""
+    stages, wire = {}, None
+    for e in events:
+        if e.get("kind") == "stage_cost" and isinstance(
+                e.get("name"), str):
+            stages[e["name"]] = e
+        elif e.get("kind") == "wire_bytes":
+            wire = e
+    if not stages and wire is None:
+        return None
+    return {"stages": stages, "wire": wire}
+
+
+def _print_attribution(att):
+    from attacking_federate_learning_tpu.utils.costs import STAGES
+
+    for name in sorted(att["stages"]):
+        ev = att["stages"][name]
+        cov = ev.get("coverage") or {}
+        cf, cb = cov.get("flops"), cov.get("bytes_accessed")
+        covtxt = ("" if cf is None else
+                  f"   coverage: flops {cf:.1%}, bytes {cb:.1%}")
+        print(f"  entry {name}{covtxt}")
+        print(f"    {'stage':<17}{'MFLOPs':>10}{'MB read+write':>15}"
+              f"{'MB temp':>10}")
+        rows = dict(ev.get("stages") or {})
+        rows["unattributed"] = ev.get("unattributed") or {}
+        for stage in tuple(STAGES) + ("unattributed",):
+            r = rows.get(stage)
+            if r is None:
+                continue
+            print(f"    {stage:<17}"
+                  f"{r.get('flops', 0) / 1e6:>10.2f}"
+                  f"{r.get('bytes_accessed', 0) / 1e6:>15.2f}"
+                  f"{r.get('temp_bytes', 0) / 1e6:>10.2f}")
+    wire = att["wire"]
+    if wire:
+        print(f"  wire seams ({wire.get('topology')}, cohort "
+              f"{wire.get('cohort')}, d={wire.get('dim')}):")
+        for seam, rec in (wire.get("seams") or {}).items():
+            extra = "  [collective]" if rec.get("collective") else ""
+            print(f"    {seam:<22}{rec.get('bytes', 0):>14,} B{extra}")
+        print(f"    {'total':<22}{wire.get('total_bytes', 0):>14,} B")
+
+
+def cmd_attribution(reg, args):
+    """Per-stage cost and per-seam wire tables from a run's schema-v9
+    ``stage_cost`` / ``wire_bytes`` events (emitted by --cost-report;
+    campaign cells carry them automatically).  With a second query,
+    diff the two runs' attributions instead — the observability
+    counterpart of ``runs diff``'s trajectory compare.  Exit 1 when a
+    run carries no attribution events."""
+    ents = [reg.resolve(args.query, args.filter)]
+    if args.b is not None:
+        ents.append(reg.resolve(args.b, args.filter))
+    atts = []
+    for e in ents:
+        att = _attribution_data(_load_run_events(e))
+        if att is None:
+            print(f"run {e['run_id']}: no stage_cost/wire_bytes "
+                  f"events — rerun with --cost-report (schema v9+)")
+            return 1
+        atts.append(att)
+    if args.json:
+        print(json.dumps({e["run_id"]: a
+                          for e, a in zip(ents, atts)}, default=str))
+        return 0
+    if len(ents) == 1:
+        print(f"== {ents[0]['run_id']} ==")
+        _print_attribution(atts[0])
+        return 0
+    from attacking_federate_learning_tpu.utils.costs import STAGES
+
+    a, b = atts
+    ida, idb = ents[0]["run_id"], ents[1]["run_id"]
+    print(f"== attribution diff: {ida} vs {idb} ==")
+    for name in sorted(set(a["stages"]) | set(b["stages"])):
+        ea, eb = a["stages"].get(name), b["stages"].get(name)
+        if ea is None or eb is None:
+            print(f"  entry {name}: only in "
+                  f"{ida if eb is None else idb}")
+            continue
+        print(f"  entry {name}  (MFLOPs: A, B, delta)")
+        ra = dict(ea.get("stages") or {})
+        ra["unattributed"] = ea.get("unattributed") or {}
+        rb = dict(eb.get("stages") or {})
+        rb["unattributed"] = eb.get("unattributed") or {}
+        for stage in tuple(STAGES) + ("unattributed",):
+            fa = (ra.get(stage) or {}).get("flops", 0.0)
+            fb = (rb.get(stage) or {}).get("flops", 0.0)
+            if fa == fb == 0:
+                continue
+            mark = "" if fa == fb else "   <-- differs"
+            print(f"    {stage:<17}{fa / 1e6:>10.2f}{fb / 1e6:>10.2f}"
+                  f"{(fb - fa) / 1e6:>+10.2f}{mark}")
+    wa, wb = a["wire"], b["wire"]
+    if wa or wb:
+        sa = (wa or {}).get("seams") or {}
+        sb = (wb or {}).get("seams") or {}
+        print("  wire seams (bytes: A, B, delta)")
+        for seam in sorted(set(sa) | set(sb)):
+            ba = (sa.get(seam) or {}).get("bytes", 0)
+            bb = (sb.get(seam) or {}).get("bytes", 0)
+            mark = "" if ba == bb else "   <-- differs"
+            print(f"    {seam:<22}{ba:>14,}{bb:>14,}{bb - ba:>+12,}"
+                  f"{mark}")
+    return 0
+
+
 def cmd_selfcheck(reg, args):
     """CI self-check (tools/smoke.sh leg 6): two refreshes must agree
     (incremental refresh is idempotent over an unchanged store), every
@@ -579,6 +698,15 @@ def main(argv=None) -> int:
                     help="skip the registry refresh (the staleness "
                          "guard warns loudly if the store moved)")
     sp.set_defaults(fn=cmd_campaign)
+    sp = sub.add_parser("attribution",
+                        help="per-stage cost + per-seam wire tables "
+                             "from v9 stage_cost/wire_bytes events "
+                             "(--cost-report runs); a second query "
+                             "diffs two runs")
+    sp.add_argument("query")
+    sp.add_argument("b", nargs="?", default=None,
+                    help="second run: diff B against the first")
+    sp.set_defaults(fn=cmd_attribution)
     sp = sub.add_parser("selfcheck",
                         help="CI: refresh idempotence + resolvability")
     sp.set_defaults(fn=cmd_selfcheck)

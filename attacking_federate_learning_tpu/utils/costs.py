@@ -34,6 +34,34 @@ conclusive on this jax (0.4.37):
 A compile that neither bumped a counter nor wrote an entry is reported
 ``uncached`` (persistent cache disabled, or the compile finished under
 ``jax_persistent_cache_min_compile_time_secs``).
+
+Stage & wire ledger (ISSUE 15).  The whole-program numbers above answer
+"what does a round cost"; two further instruments answer "where":
+
+- **Stage attribution**: the engines annotate their round programs with
+  :func:`stage_scope` — ``jax.named_scope`` under the canonical stage
+  taxonomy :data:`STAGES` (``deliver → quarantine → protect →
+  tier1_aggregate → tier2_aggregate → apply``).  The scopes are
+  metadata-only: the optimized HLO stays computation-identical
+  (:func:`canonical_hlo` strips op metadata and canonicalizes value
+  names, so :func:`hlo_fingerprint` hashes the same program with scopes
+  on or off — ``tools/perf_gate.py --stageproof`` proves it per pinned
+  cell).  :func:`stage_attribution` then walks the annotated HLO text,
+  models per-instruction FLOPs/bytes from opcode+shapes, buckets each
+  instruction by the stage token in its ``op_name`` path, and
+  partitions the *actual* whole-program totals proportionally to the
+  modeled masses — so stage sums equal the program totals exactly by
+  construction, and ``coverage`` reports the modeled share that landed
+  in a named stage.
+
+- **Wire ledger**: :func:`wire_ledger` prices every protocol seam a
+  round crosses (broadcast down, client→tier-1 updates, tier-1→tier-2
+  all_gather, secagg mask exchange + dropout recovery, async delivery
+  ring) in bytes per round from the topology parameters alone.  The
+  hierarchical ``tier1_to_tier2`` seam is ``S·d·4`` — the same number
+  the SPMD round's measured ``collective_bytes`` pins (PR 12), which
+  ``--stageproof`` cross-checks.  Both instruments emit as schema-v9
+  events (``stage_cost`` / ``wire_bytes``) via CompileLedger.emit.
 """
 
 from __future__ import annotations
@@ -42,6 +70,51 @@ import dataclasses
 import os
 import time
 from typing import Optional
+
+# Canonical stage taxonomy, in round order.  ``deliver`` covers batch
+# gather + client update + attack craft (and the async delivery ring);
+# ``quarantine`` the fault-injection screen + async re-mask;
+# ``protect`` the secagg mask/unmask protocol; the two aggregate stages
+# the tier-1 defense kernel and the tier-2 shard reduction; ``apply``
+# the server momentum/LR update (+ round diagnostics riders).
+STAGES = ("deliver", "quarantine", "protect",
+          "tier1_aggregate", "tier2_aggregate", "apply")
+_STAGE_SET = frozenset(STAGES)
+
+_STAGE_ENV = "FL_STAGE_SCOPES"
+_stage_scopes_on = True
+
+
+def stage_scopes_enabled() -> bool:
+    """Stage scopes are on unless FL_STAGE_SCOPES=0 (env, checked per
+    trace so tests can flip it) or :func:`set_stage_scopes` disabled
+    them (how --stageproof builds the scope-free twin program)."""
+    if os.environ.get(_STAGE_ENV, "1") == "0":
+        return False
+    return _stage_scopes_on
+
+
+def set_stage_scopes(enabled: bool) -> bool:
+    """Process-wide stage-scope switch; returns the previous value."""
+    global _stage_scopes_on
+    prev = _stage_scopes_on
+    _stage_scopes_on = bool(enabled)
+    return prev
+
+
+def stage_scope(name: str):
+    """``jax.named_scope(name)`` for a canonical stage — metadata-only
+    annotation (op_name path component) on every op traced under it,
+    or a no-op context when scopes are disabled.  Importable without
+    jax; jax loads on first enabled use."""
+    assert name in STAGES, f"unknown stage {name!r} (taxonomy: {STAGES})"
+    if not stage_scopes_enabled():
+        import contextlib
+
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.named_scope(name)
 
 
 # Cost-analysis keys we surface (cost_analysis() returns many more
@@ -76,6 +149,11 @@ class CostRecord:
     collective_bytes: int = 0
     compile_s: float = 0.0
     cache: str = "uncached"
+    # Per-stage partition of the totals above (stage_attribution output;
+    # None when the backend withheld HLO text).  Deliberately NOT part
+    # of gate_facts — the attribution is derived from the same program
+    # the exact facts already pin.
+    attribution: Optional[dict] = None
 
     @property
     def peak_bytes(self) -> int:
@@ -98,6 +176,17 @@ class CostRecord:
         return dict(kind="compile", name=self.name,
                     compile_s=round(self.compile_s, 4), cache=self.cache,
                     platform=self.platform)
+
+    def stage_event(self) -> Optional[dict]:
+        """Payload for a 'stage_cost' event (metrics.py schema v9), or
+        None when no attribution was computable for this entry."""
+        if self.attribution is None:
+            return None
+        att = self.attribution
+        return dict(kind="stage_cost", name=self.name,
+                    stages=att["stages"],
+                    unattributed=att["unattributed"],
+                    coverage=att["coverage"])
 
     def gate_facts(self) -> dict:
         """The facts tools/perf_gate.py diffs: exact ones first, then
@@ -214,6 +303,262 @@ def collective_hlo_bytes(text: str) -> dict:
     return {"total": sum(per_op.values()), "per_op": per_op}
 
 
+# --- canonical HLO (metadata-stripped computation identity) ------------
+
+# One attribute blob: metadata={op_type="..." op_name="..." ...}.
+# Brace-free except inside the quoted strings, which the alternation
+# steps over — so op_name paths may contain anything but a quote.
+_METADATA_RE = None
+_VALUE_NAME_RE = None
+
+
+def canonical_hlo(text: str) -> str:
+    """The computation-identity view of an HLO module text: op metadata
+    stripped and every %value/%computation name rewritten to its
+    first-appearance ordinal.  Two programs are computation-identical
+    iff their canonical texts match — op_name scopes, source lines and
+    instruction-id drift are all erased, while opcodes, shapes, operand
+    wiring and attributes all still compare."""
+    import re
+
+    global _METADATA_RE, _VALUE_NAME_RE
+    if _METADATA_RE is None:
+        _METADATA_RE = re.compile(
+            r",?\s*metadata=\{(?:[^{}\"]|\"[^\"]*\")*\}")
+        _VALUE_NAME_RE = re.compile(r"%[\w.\-]+")
+    stripped = _METADATA_RE.sub("", text)
+    names: dict = {}
+
+    def rename(m):
+        return names.setdefault(m.group(0), f"%v{len(names)}")
+
+    return _VALUE_NAME_RE.sub(rename, stripped)
+
+
+def hlo_fingerprint(text: str) -> str:
+    """sha256 of :func:`canonical_hlo` — the hash the byte-identical-HLO
+    gates compare now that stage scopes legally perturb metadata."""
+    import hashlib
+
+    return hashlib.sha256(canonical_hlo(text).encode()).hexdigest()
+
+
+# --- per-stage static attribution --------------------------------------
+
+# Instruction lines whose cost is carried elsewhere (callees are listed
+# as their own computations and counted there; parameters/constants/
+# tuple plumbing move no unique data):
+_SKIP_OPS = frozenset({
+    "parameter", "constant", "get-tuple-element", "tuple", "fusion",
+    "while", "call", "conditional", "bitcast", "after-all",
+    "opt-barrier", "partition-id", "replica-id",
+})
+# Elementwise-ish opcodes modeled at one FLOP per output element:
+_EW_OPS = frozenset({
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "abs", "negate", "exponential", "exponential-minus-one", "log",
+    "log-plus-one", "power", "sqrt", "rsqrt", "cbrt", "tanh",
+    "logistic", "sine", "cosine", "sign", "floor", "ceil",
+    "round-nearest-afz", "round-nearest-even", "compare", "select",
+    "clamp", "and", "or", "xor", "not", "shift-left",
+    "shift-right-logical", "shift-right-arithmetic", "remainder",
+    "atan2", "is-finite", "rng-bit-generator",
+})
+
+_INSTR_RE = None
+_SHAPE_RE = None
+_OPNAME_RE = None
+_CDIMS_RE = None
+
+
+def _shape_bytes_elems(shape_text: str):
+    """[(bytes, elems)] for every dtype[dims] shape in a text span."""
+    out = []
+    for dtype, dims in _SHAPE_RE.findall(shape_text):
+        width = _DTYPE_BYTES.get(dtype)
+        if width is None:
+            continue              # 'devices[8,1]' etc. never bill
+        elems = 1
+        for d in filter(None, dims.split(",")):
+            elems *= int(d)
+        out.append((elems * width, elems))
+    return out
+
+
+def _instr_flops(op: str, out_shapes, operand_text: str) -> float:
+    """Modeled FLOPs for one instruction — a *mass* used only to split
+    the program's actual totals proportionally, so relative fidelity is
+    what matters, not absolute counts."""
+    out_elems = sum(e for _, e in out_shapes)
+    if op == "dot":
+        contract = 1
+        m = _CDIMS_RE.search(operand_text)
+        lhs_dims = _SHAPE_RE.search(operand_text)
+        if m and lhs_dims:
+            dims = [int(d) for d in
+                    filter(None, lhs_dims.group(2).split(","))]
+            for idx in filter(None, m.group(1).split(",")):
+                i = int(idx)
+                if i < len(dims):
+                    contract *= dims[i]
+        return 2.0 * out_elems * contract
+    if op == "convolution":
+        ops = _shape_bytes_elems(operand_text)
+        kernel = ops[1][1] if len(ops) > 1 else 1
+        return 2.0 * out_elems * kernel
+    if op in ("reduce", "reduce-window"):
+        ops = _shape_bytes_elems(operand_text)
+        return float(ops[0][1]) if ops else float(out_elems)
+    if op == "sort":
+        import math
+
+        return out_elems * max(1.0, math.log2(max(out_elems, 2)))
+    if op in _EW_OPS:
+        return float(out_elems)
+    return 0.0
+
+
+def stage_attribution(text: str, totals: Optional[dict] = None) -> dict:
+    """Partition whole-program cost per canonical stage from annotated
+    HLO text.
+
+    Walks every instruction line in the module (fusion/while bodies are
+    their own computations, so each op is seen exactly once), models
+    its FLOPs (opcode+shapes) and bytes (all typed shapes on the line),
+    and buckets both by the first :data:`STAGES` token in the op's
+    ``op_name`` metadata path — ``unattributed`` when no stage scope
+    encloses it.  When ``totals`` carries the program's actual
+    ``flops`` / ``bytes_accessed`` / ``temp_bytes`` (compiled_cost_facts),
+    each metric is split proportionally to the modeled masses with the
+    residual folded into ``unattributed`` — so the per-stage values sum
+    to the program total *exactly*.  ``coverage`` is the modeled share
+    attributed to named stages (the --stageproof ≥95% bar)."""
+    import math
+    import re
+
+    global _INSTR_RE, _SHAPE_RE, _OPNAME_RE, _CDIMS_RE
+    global _METADATA_RE
+    if _METADATA_RE is None:
+        canonical_hlo("")         # compile the shared metadata regex
+    if _INSTR_RE is None:
+        _INSTR_RE = re.compile(
+            r"^\s*(?:ROOT\s+)?%[\w.\-]+\s*=\s*"
+            r"(?P<shape>\([^)]*\)|[a-z][a-z0-9]*\[[0-9,]*\]"
+            r"(?:\{[^}]*\})?)\s+(?P<op>[\w\-]+)\(")
+        _SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+        _OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+        _CDIMS_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+    mass: dict = {s: {"flops": 0.0, "bytes": 0.0} for s in STAGES}
+    mass["unattributed"] = {"flops": 0.0, "bytes": 0.0}
+    for line in text.splitlines():
+        m = _INSTR_RE.match(line)
+        if m is None or m.group("op") in _SKIP_OPS:
+            continue
+        nm = _OPNAME_RE.search(line)
+        # Innermost taxonomy token wins: an outer scope around a whole
+        # call region (e.g. the hierarchical megabatch scan) attributes
+        # the region's *plumbing* (carry writes, estimate stacking)
+        # without clobbering the finer stages annotated inside it.
+        sm = ([t for t in nm.group(1).split("/") if t in _STAGE_SET]
+              if nm else None)
+        stage = sm[-1] if sm else "unattributed"
+        body = _METADATA_RE.sub("", line) if _METADATA_RE else line
+        after = body.split(m.group("op") + "(", 1)
+        operand_text = after[1] if len(after) > 1 else ""
+        out_shapes = _shape_bytes_elems(m.group("shape"))
+        mass[stage]["flops"] += _instr_flops(
+            m.group("op"), out_shapes, operand_text)
+        mass[stage]["bytes"] += sum(
+            b for b, _ in _shape_bytes_elems(body))
+    named_f = math.fsum(mass[s]["flops"] for s in STAGES)
+    named_b = math.fsum(mass[s]["bytes"] for s in STAGES)
+    total_f = named_f + mass["unattributed"]["flops"]
+    total_b = named_b + mass["unattributed"]["bytes"]
+    out = {
+        "stages": {}, "unattributed": {},
+        "coverage": {
+            "flops": named_f / total_f if total_f else 0.0,
+            "bytes_accessed": named_b / total_b if total_b else 0.0,
+        },
+    }
+    # Metric → which modeled mass splits it.
+    metric_mass = {"flops": "flops", "bytes_accessed": "bytes",
+                   "temp_bytes": "bytes"}
+    totals = totals or {}
+    for metric, mkey in metric_mass.items():
+        total = totals.get(metric)
+        if total is None or total < 0:
+            continue
+        denom = math.fsum(mass[s][mkey] for s in STAGES) \
+            + mass["unattributed"][mkey]
+        shares = {}
+        for s in STAGES:
+            shares[s] = total * (mass[s][mkey] / denom) if denom else 0.0
+            out["stages"].setdefault(s, {})[metric] = shares[s]
+        # Residual → unattributed, so the partition sums exactly.
+        out["unattributed"][metric] = total - math.fsum(
+            shares[s] for s in STAGES)
+    out["model_mass"] = {s: dict(v) for s, v in mass.items()}
+    return out
+
+
+# --- per-seam wire ledger ----------------------------------------------
+
+# Every protocol seam a round can cross, in round order.  Absent seams
+# (e.g. tier1_to_tier2 on a flat topology) are omitted, zero-byte seams
+# (secagg on, nobody dropped) are kept — the column exists, it is empty.
+WIRE_SEAMS = ("broadcast", "client_update", "tier1_to_tier2",
+              "secagg_mask_exchange", "secagg_recovery",
+              "async_delivery")
+
+
+def wire_ledger(*, cohort: int, dim: int, grad_bytes: int = 4,
+                topology: str = "flat", num_shards: Optional[int] = None,
+                megabatch: Optional[int] = None, spmd_parts: int = 1,
+                secagg: str = "off", key_bytes: int = 32,
+                dropped: int = 0,
+                async_buffer: Optional[int] = None) -> dict:
+    """Bytes-per-round on every protocol seam, priced from the topology
+    parameters alone (f32 model wire; ``grad_bytes`` prices a quantized
+    client→server leg, ROADMAP item 4's baseline column).
+
+    Seams: server→client ``broadcast`` (every cohort member pulls the
+    d-dim f32 model), ``client_update`` (cohort·d·grad_bytes up),
+    hierarchical ``tier1_to_tier2`` (S estimates to the tier-2 reducer
+    — exactly the ``S·d·4`` the SPMD all_gather moves per device, the
+    PR 12 measured-collective cross-check), secagg ``mask_exchange``
+    (one pairwise key/masked-seed exchange per client pair — vanilla
+    C(n,2), groupwise S·C(m,2)) + ``recovery`` (each dropout makes
+    every survivor reveal one pairwise secret), and the ``async
+    delivery`` ring (buffer-capacity updates of d·grad_bytes per round,
+    the capacity bound on what one round can deliver)."""
+    seams: dict = {}
+    seams["broadcast"] = {"bytes": cohort * dim * 4}
+    seams["client_update"] = {"bytes": cohort * dim * grad_bytes}
+    if topology == "hierarchical" and num_shards:
+        seams["tier1_to_tier2"] = {
+            "bytes": num_shards * dim * 4,
+            "collective": spmd_parts > 1,
+        }
+    if secagg != "off":
+        if secagg == "groupwise" and num_shards and megabatch:
+            pairs = num_shards * (megabatch * (megabatch - 1) // 2)
+        else:
+            pairs = cohort * (cohort - 1) // 2
+        seams["secagg_mask_exchange"] = {"bytes": pairs * key_bytes}
+        seams["secagg_recovery"] = {
+            "bytes": dropped * max(cohort - 1, 0) * key_bytes}
+    if topology == "async" and async_buffer:
+        seams["async_delivery"] = {
+            "bytes": async_buffer * dim * grad_bytes}
+    return {
+        "topology": topology, "cohort": cohort, "dim": dim,
+        "grad_bytes": grad_bytes,
+        "seams": seams,
+        "total_bytes": sum(s["bytes"] for s in seams.values()),
+    }
+
+
 # --- per-entry-point analysis ------------------------------------------
 
 def _first(d):
@@ -283,8 +628,13 @@ def analyze_lowered(name: str, lowered) -> CostRecord:
         cache = "miss"
     else:
         cache = "uncached"
+    facts = compiled_cost_facts(compiled)
     rec = CostRecord(name=name, platform=platform, compile_s=dt,
-                     cache=cache, **compiled_cost_facts(compiled))
+                     cache=cache, **facts)
+    try:
+        rec.attribution = stage_attribution(compiled.as_text(), facts)
+    except Exception:
+        rec.attribution = None     # text unavailable on some backends
     return rec
 
 
@@ -297,6 +647,9 @@ class CompileLedger:
         self.errors: list = []   # (name, message) for entries that
         # failed to lower/compile — kept out of records so the gate
         # never diffs a partial fact set silently
+        self.wire: Optional[dict] = None   # wire_ledger() output —
+        # core/engine.py:cost_report attaches the run's per-seam
+        # bytes-on-wire so emit() can version it as one event
 
     def analyze(self, name: str, lowered) -> CostRecord:
         rec = analyze_lowered(name, lowered)
@@ -304,10 +657,17 @@ class CompileLedger:
         return rec
 
     def emit(self, logger) -> None:
-        """Write one 'compile' + one 'cost' event per record."""
+        """Write one 'compile' + one 'cost' (+ one 'stage_cost' when
+        attribution was computable) event per record, and one
+        'wire_bytes' event when a wire ledger is attached."""
         for rec in self.records:
             logger.record(**rec.compile_event())
             logger.record(**rec.cost_event())
+            stage = rec.stage_event()
+            if stage is not None:
+                logger.record(**stage)
+        if self.wire is not None:
+            logger.record(kind="wire_bytes", **self.wire)
 
     def summary(self) -> dict:
         """{name: gate_facts} — the shape PERF_BASELINE.json stores."""

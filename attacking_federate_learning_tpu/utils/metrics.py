@@ -49,7 +49,15 @@ campaign-scheduler transition per record
 cell start and the cell's terminal verdict done/failed/skipped/
 adopted, deadline checkpoints — written to the campaign's own
 ``runs/campaigns/<id>/events.jsonl``, never into a run's log by the
-engine).
+engine); v9 adds the stage & wire ledger kinds (utils/costs.py,
+emitted by CompileLedger.emit under --cost-report) — ``stage_cost``
+(one per compiled entry point: the whole-program FLOPs/bytes/temp
+partitioned across the canonical stage taxonomy ``deliver →
+quarantine → protect → tier1_aggregate → tier2_aggregate → apply``
+plus the unattributed residual and the modeled coverage) and
+``wire_bytes`` (one per run: bytes-per-round on every protocol seam —
+broadcast, client_update, tier1_to_tier2, secagg mask exchange /
+recovery, async delivery).
 Readers accept every version; older logs simply never carry the newer
 kinds, and a newer-only kind stamped with an older version is an
 emitter bug, rejected (``KIND_MIN_VERSION``).
@@ -67,8 +75,8 @@ from typing import Optional
 import numpy as np
 
 
-SCHEMA_VERSION = 8
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)
+SCHEMA_VERSION = 9
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
 
 # kind -> required fields.  Producers: core/engine.py (round, eval, asr,
 # profile, stream, defense, attack, selection_hist via RunLogger).
@@ -159,6 +167,18 @@ EVENT_KINDS = {
     # the cell id, rejection reason, cache hit/miss evidence and
     # summary metrics riding along as diagnostics
     "campaign": {"campaign", "phase"},
+    # --- v9: the stage & wire ledger (utils/costs.py) -------------------
+    # one per compiled entry point (CompileLedger.emit): the program's
+    # actual totals partitioned per canonical stage ('stages': stage ->
+    # {flops, bytes_accessed, temp_bytes}), the unattributed residual
+    # (partition sums equal the 'cost' event's totals exactly) and the
+    # modeled coverage fractions the perf gate's --stageproof bars
+    "stage_cost": {"name", "stages", "coverage"},
+    # one per run: bytes-per-round on every protocol seam the topology
+    # crosses ('seams': seam -> {bytes, ...}; the hierarchical
+    # tier1_to_tier2 seam reproduces the measured SPMD all_gather
+    # collective_bytes == S·d·4)
+    "wire_bytes": {"topology", "seams", "total_bytes"},
 }
 
 # Minimum schema version per kind introduced after v1; an event carrying
@@ -167,7 +187,8 @@ EVENT_KINDS = {
 KIND_MIN_VERSION = {"compile": 2, "cost": 2, "heartbeat": 2,
                     "lifecycle": 3, "registry": 4, "gate": 4,
                     "secagg": 5, "shard_selection": 6, "forensics": 6,
-                    "async": 7, "campaign": 8}
+                    "async": 7, "campaign": 8,
+                    "stage_cost": 9, "wire_bytes": 9}
 
 # Back-compat alias (pre-v3 spelling used by external readers).
 V2_KINDS = {k for k, v in KIND_MIN_VERSION.items() if v == 2}

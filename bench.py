@@ -554,6 +554,17 @@ def main():
             recap(f"  static cost [krum_xla]: flops={rec.flops:.3e} "
                   f"bytes={rec.bytes_accessed:.3e} "
                   f"peak={rec.peak_bytes / 1e6:.1f} MB")
+            # Wire-ledger rollup for the headline cohort (ISSUE 15):
+            # the per-seam protocol bytes the same (n, d) round moves,
+            # priced from topology facts alone — next to the compute
+            # cost so a BENCH record carries both sides of the budget.
+            from attacking_federate_learning_tpu.utils.costs import (
+                wire_ledger
+            )
+            RESULT["wire"] = wire_ledger(cohort=n, dim=DIM)
+            recap(f"  wire ledger [flat n={n}]: "
+                  f"{RESULT['wire']['total_bytes'] / 1e6:.1f} MB/round "
+                  f"over {len(RESULT['wire']['seams'])} seams")
         except Exception as e:
             log(f"  (static cost analysis unavailable: "
                 f"{type(e).__name__}: {e})")

@@ -118,6 +118,10 @@ def test_cost_report_fused_entries_and_events(tmp_path):
         evs = [json.loads(line) for line in f]
     assert sum(e["kind"] == "compile" for e in evs) == 4
     assert sum(e["kind"] == "cost" for e in evs) == 4
+    # ISSUE 15: every analyzed entry carries its stage attribution, and
+    # the run carries exactly one per-seam wire ledger.
+    assert sum(e["kind"] == "stage_cost" for e in evs) == 4
+    assert sum(e["kind"] == "wire_bytes" for e in evs) == 1
     for e in evs:
         validate_event(e)
 
@@ -358,6 +362,248 @@ def test_checked_in_baseline_matches_this_environment():
     assert doc["env"] == pg.environment()
     # And the cheapest cell actually gates clean against it.
     assert pg.main(["--cells", "nodefense"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# stage & wire ledger (ISSUE 15)
+
+def _round_compiled(exp):
+    """Lower + compile the engine's round entry (the program
+    --stageproof gates; signature varies by topology)."""
+    t0 = jnp.asarray(0, jnp.int32)
+    if exp._async is not None:
+        return exp._fused_round.lower(
+            exp.state, t0, exp._async_state, None).compile()
+    if exp.faults is not None:
+        return exp._fused_round.lower(
+            exp.state, t0, exp._fault_state, None).compile()
+    return exp._fused_round.lower(exp.state, t0).compile()
+
+
+# Topology overrides per defense family.  Bulyan's 4f+3 validity bound
+# needs wider cohorts: n=11/f=2 flat (the perf-gate pinned base), the
+# gate's hier_bulyan shape for two-tier (megabatch >= 4*f1+3), and a
+# full-cohort buffer under async (k=11 >= 4f+3).
+_TOPO = {
+    "flat": dict(),
+    "hierarchical": dict(aggregation="hierarchical", users_count=12,
+                         mal_prop=0.25, megabatch=4),
+    "async": dict(aggregation="async", async_buffer=8),
+}
+_TOPO_BULYAN = {
+    "flat": dict(users_count=11, mal_prop=0.2),
+    "hierarchical": dict(aggregation="hierarchical", users_count=24,
+                         mal_prop=0.125, megabatch=8,
+                         tier2_defense="TrimmedMean"),
+    "async": dict(aggregation="async", users_count=11, mal_prop=0.2,
+                  async_buffer=11),
+}
+
+
+@pytest.mark.parametrize("topology", ["flat", "hierarchical", "async"])
+@pytest.mark.parametrize("defense",
+                         ["Krum", "TrimmedMean", "Bulyan", "Median"])
+def test_stage_attribution_partitions_round(tmp_path, defense, topology):
+    """Acceptance (ISSUE 15): on every tier-1 defense x topology the
+    stage partition sums to XLA's own whole-program totals exactly,
+    coverage clears the --stageproof bar, and the stages that must be
+    populated are (tier2_aggregate appears on the two-tier topology
+    and ONLY there)."""
+    import math
+
+    over = (_TOPO_BULYAN if defense == "Bulyan" else _TOPO)[topology]
+    exp = _exp(_cfg(tmp_path, defense=defense, **over))
+    compiled = _round_compiled(exp)
+    facts = costs.compiled_cost_facts(compiled)
+    att = costs.stage_attribution(compiled.as_text(), facts)
+    for metric in ("flops", "bytes_accessed", "temp_bytes"):
+        parts = [att["stages"][s][metric] for s in costs.STAGES]
+        parts.append(att["unattributed"][metric])
+        assert math.isclose(math.fsum(parts), facts[metric],
+                            rel_tol=1e-9, abs_tol=1e-6), metric
+    assert att["coverage"]["flops"] >= 0.95
+    assert att["stages"]["deliver"]["flops"] > 0
+    assert att["stages"]["tier1_aggregate"]["flops"] > 0
+    assert att["stages"]["apply"]["flops"] > 0
+    if topology == "hierarchical":
+        assert att["stages"]["tier2_aggregate"]["flops"] > 0
+    else:
+        assert att["stages"]["tier2_aggregate"]["flops"] == 0
+
+
+def test_pallas_cell_attributes_to_tier1(tmp_path):
+    """The pallas defense-kernel dispatch is scoped: its (interpret-
+    mode, on CPU) compute books under tier1_aggregate, not
+    unattributed."""
+    exp = _exp(_cfg(tmp_path, defense="Krum", aggregation_impl="pallas"))
+    compiled = _round_compiled(exp)
+    att = costs.stage_attribution(compiled.as_text(),
+                                  costs.compiled_cost_facts(compiled))
+    assert att["stages"]["tier1_aggregate"]["flops"] > 0
+    assert att["stages"]["tier1_aggregate"]["bytes_accessed"] > 0
+
+
+def test_stage_scopes_are_metadata_only(tmp_path):
+    """Scopes off must leave the compiled program identical up to
+    metadata: the canonicalized fingerprint matches, while the
+    annotated text itself differs (the scopes ARE there)."""
+    ds = load_dataset(C.SYNTH_MNIST, seed=0, synth_train=256,
+                      synth_test=64)
+
+    def compiled_text(on):
+        prev = costs.set_stage_scopes(on)
+        try:
+            cfg = _cfg(tmp_path, defense="Krum")
+            exp = FederatedExperiment(cfg, attacker=DriftAttack(1.0),
+                                      dataset=ds)
+            return _round_compiled(exp).as_text()
+        finally:
+            costs.set_stage_scopes(prev)
+
+    on, off = compiled_text(True), compiled_text(False)
+    assert costs.hlo_fingerprint(on) == costs.hlo_fingerprint(off)
+    assert "tier1_aggregate" in on and "tier1_aggregate" not in off
+
+
+def test_wire_ledger_seam_math():
+    """Pure seam pricing: every seam the topology crosses, nothing it
+    doesn't, totals additive, and the hierarchical seam is the PR 12
+    S*d*4 collective identity."""
+    flat = costs.wire_ledger(cohort=16, dim=100)
+    assert set(flat["seams"]) == {"broadcast", "client_update"}
+    assert flat["seams"]["broadcast"]["bytes"] == 16 * 100 * 4
+    assert flat["total_bytes"] == 2 * 16 * 100 * 4
+
+    hier = costs.wire_ledger(cohort=64, dim=79510,
+                             topology="hierarchical", num_shards=8,
+                             megabatch=8, spmd_parts=4)
+    assert hier["seams"]["tier1_to_tier2"]["bytes"] == 8 * 79510 * 4
+    assert hier["seams"]["tier1_to_tier2"]["collective"] is True
+
+    sa = costs.wire_ledger(cohort=12, dim=100, secagg="vanilla",
+                           dropped=2)
+    assert sa["seams"]["secagg_mask_exchange"]["bytes"] == 66 * 32
+    assert sa["seams"]["secagg_recovery"]["bytes"] == 2 * 11 * 32
+    gw = costs.wire_ledger(cohort=12, dim=100, secagg="groupwise",
+                           topology="hierarchical", num_shards=3,
+                           megabatch=4)
+    assert gw["seams"]["secagg_mask_exchange"]["bytes"] == 3 * 6 * 32
+
+    asy = costs.wire_ledger(cohort=12, dim=100, topology="async",
+                            async_buffer=8)
+    assert asy["seams"]["async_delivery"]["bytes"] == 8 * 100 * 4
+    for led in (flat, hier, sa, gw, asy):
+        assert led["total_bytes"] == sum(
+            s["bytes"] for s in led["seams"].values())
+
+
+def test_engine_wire_ledger_matches_topology(tmp_path):
+    """FederatedExperiment.wire_ledger() fills the seam parameters from
+    the live engine: hierarchical carries the S*d*4 seam sized by ITS
+    placement."""
+    exp = _exp(_cfg(tmp_path, defense="Krum", aggregation="hierarchical",
+                    users_count=12, mal_prop=0.25, megabatch=4))
+    led = exp.wire_ledger()
+    S = exp._placement.num_shards
+    assert led["seams"]["tier1_to_tier2"]["bytes"] == S * exp.flat.dim * 4
+    assert led["seams"]["broadcast"]["bytes"] == exp.m * exp.flat.dim * 4
+
+
+def test_v9_kinds_and_version_rules():
+    validate_event({"kind": "stage_cost", "name": "fused_round",
+                    "stages": {"deliver": {"flops": 1.0}},
+                    "unattributed": {"flops": 0.0},
+                    "coverage": {"flops": 0.99}, "v": 9})
+    validate_event({"kind": "wire_bytes", "topology": "flat",
+                    "seams": {"broadcast": {"bytes": 4}},
+                    "total_bytes": 4, "v": 9})
+    # A v9-only kind stamped v8 is an emitter bug.
+    with pytest.raises(ValueError, match="need schema v9"):
+        validate_event({"kind": "wire_bytes", "topology": "flat",
+                        "seams": {}, "total_bytes": 0, "v": 8})
+
+
+def test_no_reporting_means_no_ledger_events(tmp_path):
+    """The telemetry-off invariant: without --cost-report nothing emits
+    stage_cost/wire_bytes (cost_report without a logger writes no file;
+    a plain logged run carries neither kind)."""
+    cfg = _cfg(tmp_path, defense="Krum")
+    exp = _exp(cfg)
+    ledger = exp.cost_report()         # no logger: analysis only
+    assert ledger.wire is not None     # the facts exist...
+    with RunLogger(cfg, None, str(tmp_path), jsonl_name="plain") as lg:
+        lg.record(kind="round", round=0)
+        path = lg.jsonl_path
+    with open(path) as f:              # ...but never reached the log
+        kinds = {json.loads(line)["kind"] for line in f}
+    assert "stage_cost" not in kinds and "wire_bytes" not in kinds
+
+
+# ---------------------------------------------------------------------------
+# runs attribution (registry verb over the banked v9 events)
+
+@pytest.fixture(scope="module")
+def attr_store(tmp_path_factory):
+    from attacking_federate_learning_tpu import cli
+
+    tmp = tmp_path_factory.mktemp("attr")
+    base = ["-s", "SYNTH_MNIST", "-e", "4", "-c", "16", "-n", "9",
+            "-m", "0.22", "--synth-train", "256", "--synth-test", "64",
+            "--log-dir", str(tmp / "logs"), "--run-dir", str(tmp / "runs"),
+            "--journal", "--no-checkpoint"]
+    cli.main(base + ["-d", "Krum", "--cost-report", "--run-id", "attrA"])
+    cli.main(base + ["-d", "TrimmedMean", "--cost-report",
+                     "--run-id", "attrB"])
+    cli.main(base + ["-d", "Krum", "--run-id", "plain"])
+    return tmp
+
+
+def _runs(store, *verb):
+    from attacking_federate_learning_tpu import cli
+
+    return cli.main(["runs", "--run-dir", str(store / "runs"),
+                     "--bench", "", "--progress", ""] + list(verb))
+
+
+def test_runs_attribution_single_and_diff(attr_store, capsys):
+    assert _runs(attr_store, "attribution", "attrA") == 0
+    out = capsys.readouterr().out
+    assert "tier1_aggregate" in out and "broadcast" in out
+    assert "coverage" in out
+    assert _runs(attr_store, "attribution", "attrA", "attrB") == 0
+    out = capsys.readouterr().out
+    assert "attrA" in out and "attrB" in out
+    assert "tier1_aggregate" in out
+
+
+def test_runs_attribution_json(attr_store, capsys):
+    assert _runs(attr_store, "--json", "attribution", "attrA") == 0
+    out = capsys.readouterr().out
+    # The registry refresh banner precedes the payload; parse from the
+    # first JSON line.
+    doc = json.loads(out[out.index("{"):])
+    att = doc["attrA"]
+    assert "fused_round" in att["stages"]
+    assert att["wire"]["total_bytes"] > 0
+
+
+def test_runs_attribution_without_events_exits_1(attr_store, capsys):
+    assert _runs(attr_store, "attribution", "plain") == 1
+    assert "--cost-report" in capsys.readouterr().out
+
+
+def test_cost_report_run_log_validates(attr_store):
+    """The --cost-report run's private log round-trips check_events
+    (v9 kinds included), and the plain run carries neither kind."""
+    ce = _load_tool("check_events")
+    counts, _, errors = ce.check_file(
+        str(attr_store / "logs" / "attrA.jsonl"))
+    assert not errors
+    assert counts["stage_cost"] >= 4 and counts["wire_bytes"] == 1
+    counts, _, errors = ce.check_file(
+        str(attr_store / "logs" / "plain.jsonl"))
+    assert not errors
+    assert "stage_cost" not in counts and "wire_bytes" not in counts
 
 
 # ---------------------------------------------------------------------------

@@ -22,7 +22,12 @@ aggregation='async', core/async_rounds.py — plus v8's 'campaign'
 kind: one campaign-scheduler transition per record — campaign
 start/done, cell start/done/failed/skipped verdicts and deadline
 checkpoints — written to runs/campaigns/<id>/events.jsonl,
-campaigns/scheduler.py).  An
+campaigns/scheduler.py — plus v9's observability kinds:
+'stage_cost' per-entry stage-taxonomy cost attributions and
+'wire_bytes' per-seam wire ledgers, both emitted by --cost-report
+runs via utils/costs.py:CompileLedger.emit; with telemetry/reporting
+off neither kind may appear, the invariant
+tests/test_costs.py pins).  An
 event stamped with a
 version this reader does not know is reported as "produced by a newer
 writer" — a clear per-line error, never a KeyError — and a newer-only

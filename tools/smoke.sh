@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # One-liner CI smoke: event-schema validation + fault matrix + crash
 # matrix + perf gate (incl. hierarchical memproof + secagg wireproof +
-# pallas fusion proof) +
+# pallas fusion proof + stage/wire-ledger stageproof) +
 # science gate + registry selfcheck + hierarchical-aggregation smoke +
 # secure-aggregation smoke + hierarchical-telemetry/forensics smoke +
 # asynchronous-rounds smoke + campaign-engine kill/resume smoke.
@@ -12,9 +12,10 @@
 #
 # Legs (each independently CI-wired through tests/ as well):
 #   1. tools/check_events.py over every run JSONL in logs/ (schema
-#      v1-v8: round/eval/.../fault, compile/cost/heartbeat, lifecycle,
+#      v1-v9: round/eval/.../fault, compile/cost/heartbeat, lifecycle,
 #      registry/gate, secagg, shard_selection/forensics, async,
-#      campaign) — skipped when logs/ has no .jsonl yet;
+#      campaign, stage_cost/wire_bytes) — skipped when logs/ has no
+#      .jsonl yet;
 #   2. tools/fault_matrix.py — 5-round fault x defense sweep, emitted
 #      'fault' events diffed against the host replay of the schedule,
 #      plus the dropout x async-buffer leg (async + fault events
@@ -94,7 +95,7 @@ else
 fi
 
 echo "== smoke 4/11: perf_gate (+ memproof + wireproof + pallasproof"
-echo "   + shardproof) =="
+echo "   + shardproof + stageproof) =="
 python tools/perf_gate.py --memproof || fail=1
 
 echo "== smoke 5/11: science_gate (behavioral drift) =="

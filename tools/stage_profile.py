@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Stage-ledger capture: profiled flat + hierarchical rounds with the
+ISSUE-15 stage scopes live.
+
+The stage taxonomy (utils/costs.py:STAGES — deliver → quarantine →
+protect → tier1_aggregate → tier2_aggregate → apply) is threaded
+through the engines as ``jax.named_scope`` annotations.  This tool is
+the capture leg for that instrument (tools/tpu_capture.sh step 2.7):
+
+- static: per-stage FLOP/byte attribution of the compiled flat and
+  hierarchical round programs (utils/costs.py:stage_attribution) plus
+  the per-seam wire ledger — the numbers the perf gate's --stageproof
+  pins on CPU, re-derived on the live backend;
+- profiled: one short span of real rounds per topology under
+  ``jax.profiler.trace`` — because the scopes are named_scope
+  annotations, the device profile's op breakdown carries the same
+  stage tokens, so the trace in ``--trace-dir`` is attributable to the
+  taxonomy by name.
+
+``--rehearse`` pins the CPU backend first (no relay needed): same
+steps, same JSON lines, profiler trace included — the CPU dress
+rehearsal tpu_capture.sh --rehearse runs.  Without it the live device
+set is used (never launch bare during a capturable window — the
+capture script owns the lock).
+
+Prints one JSON line per cell (flat, hier) on stdout; diagnostics on
+stderr.  A cell failure banks an ``error`` record instead of killing
+the remaining cells — the relay may flap mid-step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _force_rehearse_env() -> None:
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    from attacking_federate_learning_tpu.cli import apply_backend
+
+    apply_backend("cpu")
+
+
+CELLS = {
+    "flat": dict(defense="Krum"),
+    "hier": dict(defense="Krum", aggregation="hierarchical",
+                 users_count=64, mal_prop=0.25, megabatch=8,
+                 tier2_defense="Krum"),
+}
+
+
+def run_cell(tag: str, overrides: dict, rounds: int,
+             trace_root: str | None) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.attacks import DriftAttack
+    from attacking_federate_learning_tpu.config import ExperimentConfig
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+    from attacking_federate_learning_tpu.utils.costs import (
+        compiled_cost_facts, stage_attribution, stage_scopes_enabled
+    )
+
+    base = dict(
+        dataset=C.SYNTH_MNIST, users_count=16, mal_prop=0.25,
+        batch_size=16, epochs=max(rounds, 2), test_step=max(rounds, 2),
+        seed=0, synth_train=512, synth_test=64)
+    base.update(overrides)
+    cfg = ExperimentConfig(**base)
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=base["synth_train"],
+                      synth_test=64)
+    exp = FederatedExperiment(cfg, attacker=DriftAttack(1.5), dataset=ds)
+    rec = {"tool": "stage_profile", "cell": tag,
+           "platform": jax.devices()[0].platform,
+           "n_devices": len(jax.devices()),
+           "stage_scopes_enabled": stage_scopes_enabled(),
+           "defense": cfg.defense, "aggregation": cfg.aggregation,
+           "cohort": exp.m, "d": exp.flat.dim}
+
+    t0 = time.perf_counter()
+    compiled = exp._fused_round.lower(
+        exp.state, jnp.asarray(0, jnp.int32)).compile()
+    rec["compile_s"] = round(time.perf_counter() - t0, 2)
+    facts = compiled_cost_facts(compiled)
+    att = stage_attribution(compiled.as_text(), facts)
+    rec["coverage"] = {k: round(v, 4) for k, v in att["coverage"].items()}
+    rec["stage_flops"] = {s: v["flops"] for s, v in att["stages"].items()}
+    rec["stage_bytes"] = {s: v["bytes_accessed"]
+                          for s, v in att["stages"].items()}
+    rec["unattributed_flops"] = att["unattributed"]["flops"]
+    rec["wire"] = exp.wire_ledger()
+    if cfg.aggregation == "hierarchical":
+        # The PR-12 identity the --stageproof gate pins statically,
+        # restated on the live backend's compiled program.
+        S = exp._placement.num_shards
+        rec["tier1_to_tier2_S_d_4"] = S * exp.flat.dim * 4
+
+    trace_dir = None
+    if trace_root:
+        trace_dir = os.path.join(trace_root, tag)
+        os.makedirs(trace_dir, exist_ok=True)
+    ctx = (jax.profiler.trace(trace_dir) if trace_dir
+           else contextlib.nullcontext())
+    t0 = time.perf_counter()
+    with ctx:
+        for t in range(rounds):
+            exp.run_round(t)
+        jax.block_until_ready(exp.state.weights)
+    rec["rounds"] = rounds
+    rec["wall_s"] = round(time.perf_counter() - t0, 3)
+    rec["trace_dir"] = trace_dir
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Profiled flat + hier rounds with stage scopes "
+                    "live; per-stage static attribution + wire ledger")
+    ap.add_argument("--rehearse", action="store_true",
+                    help="CPU backend (no relay needed)")
+    ap.add_argument("--rounds", type=int, default=2)
+    ap.add_argument("--trace-dir", default="logs/stage_profile_trace",
+                    help="jax.profiler trace root ('' disables)")
+    args = ap.parse_args(argv)
+
+    if args.rehearse:
+        _force_rehearse_env()
+
+    failed = False
+    for tag, overrides in CELLS.items():
+        try:
+            rec = run_cell(tag, overrides, args.rounds,
+                           args.trace_dir or None)
+        except Exception as e:       # noqa: BLE001 — bank the error,
+            # keep the remaining cells (the relay may flap mid-step)
+            rec = {"tool": "stage_profile", "cell": tag, "error":
+                   f"{type(e).__name__}: {e}"}
+            failed = True
+        print(json.dumps(rec), flush=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -62,6 +62,7 @@ if [ "$REHEARSE" = 1 ]; then
   STEP3_CELLS=()
   MB_ARGS=(--rehearse)    # pallas micro-bench: tiny shapes, interpret
   MC_ARGS=(--rehearse)    # multichip hier: CPU + 8 virtual devices
+  SP_ARGS=(--rehearse)    # stage profile: CPU backend, same steps
   probe() { return 0; }
 else
   STEP2_ENV=(env FL_TEST_TPU=1)
@@ -69,6 +70,7 @@ else
   MB_ARGS=()              # pallas micro-bench: Mosaic compile, 2048c
   MC_ARGS=()              # multichip hier: live devices (a 1-chip
                           # window banks a 'skipped' record + reason)
+  SP_ARGS=()              # stage profile: live devices + device trace
   probe() { relay_probe; }
 fi
 
@@ -170,6 +172,18 @@ cat "$OUT/multichip_$STAMP.jsonl"
 budget "step2.6-multichip-hier"
 
 probe || { echo "relay died after multichip hier" >&2; exit 1; }
+echo "== step 2.7: stage-ledger profile (stage scopes live, ISSUE 15) =="
+# One profiled flat + one hierarchical round with the stage taxonomy's
+# named_scope annotations live: static per-stage attribution + wire
+# ledger per cell, plus a jax.profiler device trace whose op breakdown
+# carries the same stage tokens (the on-TPU face of --stageproof).
+"${SUP[@]}" timeout 900 python tools/stage_profile.py \
+  ${SP_ARGS[@]+"${SP_ARGS[@]}"} --trace-dir "$OUT/stage_trace_$STAMP" \
+  >"$OUT/stage_$STAMP.jsonl" 2>>"$OUT/stage_$STAMP.log" || true
+cat "$OUT/stage_$STAMP.jsonl"
+budget "step2.7-stage-profile"
+
+probe || { echo "relay died after stage profile" >&2; exit 1; }
 echo "== step 3: BASELINE cells =="
 "${SUP[@]}" timeout 7200 python -m attacking_federate_learning_tpu.benchmarks \
   --rounds 10 ${STEP3_CELLS[@]+"${STEP3_CELLS[@]}"} 2>&1 \
