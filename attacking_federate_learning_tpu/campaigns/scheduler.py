@@ -320,7 +320,7 @@ class SupervisorExecutor:
         except (OSError, json.JSONDecodeError):
             man = {}
         for k in ("final_accuracy", "max_accuracy", "final_asr",
-                  "events"):
+                  "rounds_per_s", "events"):
             if k in man:
                 res[k] = man[k]
         if "rounds_committed" in man:
@@ -495,7 +495,7 @@ class Campaign:
         res = {"rc": 0, "adopted": True,
                "rounds": man.get("rounds_committed")}
         for k in ("final_accuracy", "max_accuracy", "final_asr",
-                  "events"):
+                  "rounds_per_s", "events"):
             if k in man:
                 res[k] = man[k]
         if isinstance(res.get("events"), str):
@@ -513,7 +513,8 @@ class Campaign:
             rec = self.journal.cells.get(c.cell_id)
             if rec:
                 for k in ("reason", "final_accuracy", "max_accuracy",
-                          "final_asr", "rounds", "wall_s", "rc",
+                          "final_asr", "rounds", "wall_s",
+                          "rounds_per_s", "rc",
                           "cache_hits", "cache_misses", "cache_bytes",
                           "adopted", "events"):
                     if k in rec:

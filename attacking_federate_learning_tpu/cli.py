@@ -370,6 +370,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "events — read with 'report forensics'")
     p.add_argument("--trace-dir", type=str, default=None,
                    help="capture a jax.profiler XLA trace into this dir")
+    p.add_argument("--profile-every", default=0, type=int, metavar="K",
+                   help="measured-walls observatory (utils/walls.py): "
+                        "time every span/eval on the host clock and "
+                        "capture + stage-book one profiler trace per K "
+                        "eval intervals, recorded as schema-v10 'wall' "
+                        "events (read with 'runs walls'); 0 disables")
     p.add_argument("--cost-report", action="store_true",
                    help="before training, lower+compile every jitted "
                         "entry point once and record its static HLO "
@@ -473,6 +479,7 @@ def config_from_args(args) -> ExperimentConfig:
         async_buffer=args.async_buffer,
         async_max_staleness=args.async_max_staleness,
         staleness_weight=args.staleness_weight,
+        profile_every=args.profile_every,
     )
 
 
@@ -540,6 +547,15 @@ def main(argv=None):
                      "--aggregation async")
     apply_backend(args.backend)
     cfg = config_from_args(args)
+    if cfg.profile_every > 0:
+        # Arm per-op CPU trace events BEFORE the first compile (XLA
+        # parses XLA_FLAGS once); without this a CPU capture carries
+        # runtime spans only and every wall books to 'unattributed'.
+        from attacking_federate_learning_tpu.utils.profiling import (
+            ensure_op_profiling
+        )
+
+        ensure_op_profiling()
 
     from attacking_federate_learning_tpu.utils.backend import (
         enable_compile_cache

@@ -202,6 +202,14 @@ class ExperimentConfig:
 
     # --- evaluation / io ------------------------------------------------
     test_step: int = 5               # reference main.py:58
+    # Measured-walls observatory (utils/walls.py): 0 = off; K > 0 times
+    # every span/eval on the host clock at the existing eval-boundary
+    # fetch (schema-v10 'wall' events, source='host') and captures one
+    # profiler trace per K eval intervals, booked onto the stage
+    # taxonomy (source='trace').  Capture is CPU-safe / TPU-gated
+    # (utils/profiling.py:device_trace); the compiled round programs
+    # are pinned byte-identical with this on or off.
+    profile_every: int = 0
     checkpoint_acc_threshold: float = 70.0  # reference main.py:84
     output: Optional[str] = None     # tee file, reference main.py:13-18
     log_dir: str = "logs"

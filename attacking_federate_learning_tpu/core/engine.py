@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Optional
 
 import jax
@@ -1897,6 +1898,72 @@ class FederatedExperiment:
         if self._check_attack_nan and bool(bad):
             raise FloatingPointError("Got nan in backdoor shadow training")
 
+    # --- measured walls (utils/walls.py; cfg.profile_every) -----------
+    def _span_entry_name(self) -> str:
+        """The ledger name of the span program run_span dispatches —
+        the same name cost_report records its stage_cost under, so the
+        measured 'wall' event joins the modeled row by name."""
+        hier = self.cfg.aggregation == "hierarchical"
+        if self._async is not None:
+            return "async_span"
+        if self.faults is not None:
+            return "fault_span"
+        if self.cfg.telemetry or self._secagg is not None:
+            return "hier_tele_span" if hier else "tele_span"
+        return "hier_span" if hier else "fused_span"
+
+    def _span_hlo_text(self, count: int) -> str:
+        """Compiled HLO text of the span program for ``count`` rounds —
+        the static side of the walls join (instruction name -> stage
+        token).  AOT lower+compile, exactly the program run_span's jit
+        call builds (warm through the persistent cache); memoized per
+        (entry, count) since the scanned spans specialize on length."""
+        name = self._span_entry_name()
+        key = (name, 1 if name == "fused_span" else int(count))
+        cache = getattr(self, "_wall_hlo_cache", None)
+        if cache is None:
+            cache = self._wall_hlo_cache = {}
+        if key not in cache:
+            t0 = jnp.asarray(0, jnp.int32)
+            if self._async is not None:
+                low = self._async_span.lower(
+                    self.state, t0, int(count), self._async_state)
+            elif self.faults is not None:
+                low = self._fault_span.lower(
+                    self.state, t0, int(count), self._fault_state)
+            elif self.cfg.telemetry or self._secagg is not None:
+                low = self._tele_span.lower(self.state, t0, int(count))
+            else:
+                # Span length is a traced operand: one compilation
+                # covers every span length, so one text does too.
+                low = self._fused_span.lower(
+                    self.state, t0, jnp.asarray(count, jnp.int32))
+            cache[key] = low.compile().as_text()
+        return cache[key]
+
+    def _book_span_walls(self, logger, trace_dir: str, count: int):
+        """Book one profiled span capture onto the stage taxonomy and
+        emit the schema-v10 'wall' event (source='trace').  Returns the
+        WallRecord, or None when the capture produced no trace (the
+        device_trace no-op path on an un-gated accelerator) — walls
+        observability must never sink the run it measures."""
+        from attacking_federate_learning_tpu.utils.walls import (
+            book_trace
+        )
+
+        try:
+            rec = book_trace(
+                trace_dir, self._span_hlo_text(count),
+                name=self._span_entry_name(),
+                platform=jax.devices()[0].platform, rounds=count)
+        except Exception as e:          # noqa: BLE001 — observability
+            logger.print(f"[walls] booking failed: "
+                         f"{type(e).__name__}: {e}")
+            return None
+        if rec is not None and logger is not None:
+            logger.record(**rec.wall_event())
+        return rec
+
     def run_span(self, start: int, count: int) -> ServerState:
         """Run ``count`` rounds [start, start+count) as one scanned device
         program when the attack is fusable; falls back to per-round calls
@@ -2268,6 +2335,18 @@ class FederatedExperiment:
             # double-count them downstream).
             return journal is None or journal.fresh_round(t)
 
+        # Measured-walls observatory (cfg.profile_every > 0, span paths
+        # only — the per-round paths already carry --profile's
+        # PhaseTimer): every span is timed on the host clock at its
+        # existing boundary, and every K-th eval interval additionally
+        # runs under a profiler capture booked onto the stage taxonomy
+        # (utils/walls.py).  Off (the default), none of this executes —
+        # no extra syncs, no events, and the compiled programs are
+        # pinned byte-identical either way (tests/test_walls.py).
+        prof_k = int(cfg.profile_every or 0)
+        walls_interval = 0
+        loop_t0 = time.perf_counter()
+
         while epoch < cfg.epochs:
             if use_spans:
                 # Advance to the next eval boundary in one device
@@ -2286,7 +2365,35 @@ class FederatedExperiment:
                                    epoch if epoch % ckpt_every == 0
                                    else (epoch // ckpt_every + 1)
                                    * ckpt_every)
-                self.run_span(epoch, boundary - epoch + 1)
+                count = boundary - epoch + 1
+                if prof_k > 0:
+                    from attacking_federate_learning_tpu.utils import (
+                        profiling as _prof
+                    )
+
+                    profiled = walls_interval % prof_k == 0
+                    walls_interval += 1
+                    trace_dir = (os.path.join(logger.log_dir,
+                                              "walltrace", f"r{epoch}")
+                                 if profiled else None)
+                    t_span = time.perf_counter()
+                    with _prof.device_trace(trace_dir):
+                        self.run_span(epoch, count)
+                        # The sync the host wall needs; the span paths
+                        # fetch at this boundary anyway, so nothing new
+                        # crosses in-jit.
+                        jax.block_until_ready(self.state.weights)
+                    span_wall = time.perf_counter() - t_span
+                    logger.record(
+                        kind="wall", source="host",
+                        name=self._span_entry_name(), round=int(epoch),
+                        rounds=int(count), wall_s=round(span_wall, 6),
+                        rounds_per_s=(round(count / span_wall, 4)
+                                      if span_wall > 0 else 0.0))
+                    if trace_dir is not None:
+                        self._book_span_walls(logger, trace_dir, count)
+                else:
+                    self.run_span(epoch, count)
                 if ((cfg.telemetry or self.faults is not None
                         or self._secagg is not None
                         or self._async is not None)
@@ -2341,8 +2448,18 @@ class FederatedExperiment:
                 # events and burn the resume window.
                 # The lambda reads `correct` after the block assigns it, so
                 # the timer blocks on the eval outputs, not stale state.
+                t_eval = time.perf_counter()
                 with phase("eval", lambda: correct):
                     test_loss, correct = self.evaluate(self.state.weights)
+                if prof_k > 0:
+                    # Host eval wall (source='host'); the block the
+                    # clock needs is the one record_eval below pays
+                    # anyway when it converts the outputs.
+                    jax.block_until_ready((test_loss, correct))
+                    logger.record(kind="wall", source="host",
+                                  name="eval", round=int(epoch),
+                                  wall_s=round(
+                                      time.perf_counter() - t_eval, 6))
                 accuracy = logger.record_eval(epoch, test_loss, correct,
                                               test_size)
                 if (accuracy > cfg.checkpoint_acc_threshold
@@ -2414,6 +2531,14 @@ class FederatedExperiment:
             )
 
             summary = {"events": os.path.abspath(logger.jsonl_path)}
+            # Headline wall summary (always-on, sync-free: total loop
+            # wall over committed rounds) — the campaign table's time
+            # column reads this off the registry entry.
+            rounds_done = int(self.state.round) - start_epoch
+            loop_wall = time.perf_counter() - loop_t0
+            if rounds_done > 0 and loop_wall > 0:
+                summary["rounds_per_s"] = round(rounds_done / loop_wall,
+                                                4)
             if logger.accuracies:
                 summary["final_accuracy"] = round(
                     float(logger.accuracies[-1]), 4)

@@ -664,9 +664,18 @@ def campaign_table(manifest, registry_entries=None) -> dict:
                     problems.append(
                         f"{cid}: no registry entry (unjournaled "
                         f"cell?); values from the campaign manifest")
-            for k in ("final_accuracy", "max_accuracy", "final_asr"):
+            for k in ("final_accuracy", "max_accuracy", "final_asr",
+                      "rounds_per_s", "wall_s"):
                 if src.get(k) is not None:
                     rec[k] = src[k]
+            # A registry-sourced cell may still carry its wall_s only
+            # in the campaign manifest (the scheduler timed the cell;
+            # the engine stamped rounds_per_s) — take either headline
+            # wherever it lives, so the time column survives both
+            # sources.
+            for k in ("rounds_per_s", "wall_s"):
+                if rec.get(k) is None and row.get(k) is not None:
+                    rec[k] = row[k]
         else:
             rec["reason"] = row.get("reason")
         cells.setdefault(f"{d}|{a}", []).append(rec)
@@ -693,18 +702,46 @@ def _campaign_cell_text(recs) -> str:
     return " ; ".join(parts) if parts else "-"
 
 
+def _row_time_text(table, d) -> str:
+    """The time-column cell for one defense row: the median engine
+    rounds/s over the row's done cells (schema-v10 measured-walls
+    headline the engine stamps into the registry), falling back to the
+    scheduler's cell wall when no engine headline exists."""
+    rps = [rec["rounds_per_s"] for a in table["cols"]
+           for rec in table["cells"].get(f"{d}|{a}", [])
+           if rec.get("rounds_per_s") is not None]
+    if rps:
+        rps.sort()
+        return f"{rps[len(rps) // 2]:.2f} r/s"
+    walls = [rec["wall_s"] for a in table["cols"]
+             for rec in table["cells"].get(f"{d}|{a}", [])
+             if rec.get("wall_s") is not None]
+    if walls:
+        walls.sort()
+        return f"{walls[len(walls) // 2]:.0f} s"
+    return "-"
+
+
 def _print_campaign_table(table, out=print):
     out(f"== campaign {table['campaign_id']}  "
         f"[{table['status']}] ==")
     width = max([len(r) for r in table["rows"]] + [7])
     cw = {a: max(len(a), 12) for a in table["cols"]}
-    out("  " + " " * width + "  "
-        + "  ".join(f"{a:>{cw[a]}s}" for a in table["cols"]))
+    has_time = any(rec.get("rounds_per_s") is not None
+                   or rec.get("wall_s") is not None
+                   for recs in table["cells"].values() for rec in recs)
+    header = ("  " + " " * width + "  "
+              + "  ".join(f"{a:>{cw[a]}s}" for a in table["cols"]))
+    if has_time:
+        header += f"  {'time':>10s}"
+    out(header)
     for d in table["rows"]:
         line = f"  {d:<{width}s}  "
         line += "  ".join(
             f"{_campaign_cell_text(table['cells'].get(f'{d}|{a}', [])):>{cw[a]}s}"
             for a in table["cols"])
+        if has_time:
+            line += f"  {_row_time_text(table, d):>10s}"
         out(line)
     skips = [(key, rec) for key, recs in table["cells"].items()
              for rec in recs if rec["state"] == "skipped"]

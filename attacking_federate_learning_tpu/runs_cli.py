@@ -36,6 +36,13 @@ the experiment flag surface stays reference-verbatim).  Verbs:
   schema-v9 ``stage_cost``/``wire_bytes`` events (any --cost-report
   run carries them; campaign cells do automatically).  A second query
   renders the two runs' stage/seam diff instead
+- ``runs walls Q [B]`` — measured per-stage wall tables from a run's
+  schema-v10 ``wall`` events (any --profile-every run carries them):
+  per-entry stage-wall medians over the run's trace captures, joined
+  to the entry's stage_cost twin for measured-vs-modeled ratios, plus
+  the host-clock span/eval rollup.  A second query renders the two
+  runs' stage-wall diff instead (delta marks fire above 25% — walls
+  are measured, so exact-equality marks would flag noise)
 - ``runs selfcheck``    — CI leg: refresh idempotence + resolvability
   over the current run store (tools/smoke.sh leg 6)
 
@@ -583,6 +590,173 @@ def cmd_attribution(reg, args):
     return 0
 
 
+def _median(vals):
+    vals = sorted(vals)
+    return vals[len(vals) // 2] if vals else None
+
+
+def _walls_data(events):
+    """The run's v10 measured-walls payloads, summarized: per-entry
+    stage-wall medians over its trace captures (joined to the entry's
+    v9 stage_cost for measured-vs-modeled ratios when present), plus
+    the host-clock span/eval rollup.  None when the run predates
+    schema v10 / ran without --profile-every."""
+    from attacking_federate_learning_tpu.utils.costs import STAGES
+    from attacking_federate_learning_tpu.utils.walls import (
+        measured_vs_modeled
+    )
+
+    spans, evals, traces, costs = [], [], {}, {}
+    for e in events:
+        if e.get("kind") == "wall":
+            if e.get("source") == "trace":
+                traces.setdefault(str(e.get("name")), []).append(e)
+            elif e.get("name") == "eval":
+                evals.append(e)
+            else:
+                spans.append(e)
+        elif e.get("kind") == "stage_cost" and isinstance(
+                e.get("name"), str):
+            costs[e["name"]] = e
+    if not spans and not evals and not traces:
+        return None
+    out = {"host": {}, "entries": {}}
+    if spans:
+        rps = [e["rounds_per_s"] for e in spans
+               if isinstance(e.get("rounds_per_s"), (int, float))]
+        out["host"]["spans"] = {
+            "count": len(spans),
+            "rounds": sum(int(e.get("rounds", 0) or 0) for e in spans),
+            "total_wall_s": round(sum(float(e.get("wall_s", 0.0))
+                                      for e in spans), 4),
+            "median_rounds_per_s": _median(rps)}
+    if evals:
+        out["host"]["evals"] = {
+            "count": len(evals),
+            "median_wall_ms": round(1e3 * _median(
+                [float(e.get("wall_s", 0.0)) for e in evals]), 3)}
+    for name, evs in traces.items():
+        agg = {"captures": len(evs),
+               "stages": {}, "unattributed_us": _median(
+                   [float(e.get("unattributed_us", 0.0))
+                    for e in evs])}
+        for s in STAGES:
+            vals = [float((e.get("stages") or {}).get(s, 0.0))
+                    for e in evs]
+            if any(v > 0 for v in vals):
+                agg["stages"][s] = _median(vals)
+        covs = [(e.get("coverage") or {}).get("op_time_fraction")
+                for e in evs]
+        covs = [c for c in covs if isinstance(c, (int, float))]
+        if covs:
+            agg["op_time_fraction"] = _median(covs)
+        if name in costs:
+            agg["vs_modeled"] = measured_vs_modeled(agg, costs[name])
+        out["entries"][name] = agg
+    return out
+
+
+def _print_walls(w):
+    from attacking_federate_learning_tpu.utils.costs import STAGES
+
+    hs = w["host"].get("spans")
+    if hs:
+        rps = hs.get("median_rounds_per_s")
+        print(f"  host walls: {hs['count']} spans / {hs['rounds']} "
+              f"rounds in {hs['total_wall_s']:.2f} s"
+              + (f", median {rps:.2f} rounds/s" if rps else ""))
+    he = w["host"].get("evals")
+    if he:
+        print(f"  evals: {he['count']}, median "
+              f"{he['median_wall_ms']:.1f} ms")
+    for name in sorted(w["entries"]):
+        agg = w["entries"][name]
+        cov = agg.get("op_time_fraction")
+        covtxt = (f"   op-time coverage {cov:.1%}"
+                  if cov is not None else "")
+        print(f"  entry {name}  ({agg['captures']} capture(s)){covtxt}")
+        ratios = agg.get("vs_modeled") or {}
+        print(f"    {'stage':<17}{'measured ms':>13}{'share':>8}"
+              f"{'modeled':>9}{'ratio':>8}")
+        rows = dict(agg.get("stages") or {})
+        rows["unattributed"] = agg.get("unattributed_us") or 0.0
+        for stage in tuple(STAGES) + ("unattributed",):
+            us = rows.get(stage)
+            if us is None or (us == 0.0 and stage not in ratios):
+                continue
+            r = ratios.get(stage) or {}
+            share = r.get("measured_share")
+            modeled = r.get("modeled_share")
+            ratio = r.get("ratio")
+            print(f"    {stage:<17}{us / 1e3:>13.3f}"
+                  + (f"{share:>8.1%}" if share is not None
+                     else f"{'':>8}")
+                  + (f"{modeled:>9.1%}" if modeled is not None
+                     else f"{'-':>9}")
+                  + (f"{ratio:>8.2f}" if ratio is not None
+                     else f"{'-':>8}"))
+
+
+def cmd_walls(reg, args):
+    """Measured per-stage wall tables from a run's schema-v10 'wall'
+    events (emitted by --profile-every), with measured-vs-modeled
+    ratios wherever the run also carries the v9 stage_cost twin.  With
+    a second query, diff the two runs' stage walls instead — delta
+    marks flag stages whose medians moved by more than 25% (walls are
+    measured, so exact-equality marks would fire on noise).  Exit 1
+    when a run carries no wall events."""
+    ents = [reg.resolve(args.query, args.filter)]
+    if args.b is not None:
+        ents.append(reg.resolve(args.b, args.filter))
+    walls = []
+    for e in ents:
+        w = _walls_data(_load_run_events(e))
+        if w is None:
+            print(f"run {e['run_id']}: no wall events — rerun with "
+                  f"--profile-every K (schema v10+)")
+            return 1
+        walls.append(w)
+    if args.json:
+        print(json.dumps({e["run_id"]: w
+                          for e, w in zip(ents, walls)}, default=str))
+        return 0
+    if len(ents) == 1:
+        print(f"== {ents[0]['run_id']} ==")
+        _print_walls(walls[0])
+        return 0
+    from attacking_federate_learning_tpu.utils.costs import STAGES
+
+    a, b = walls
+    ida, idb = ents[0]["run_id"], ents[1]["run_id"]
+    print(f"== walls diff: {ida} vs {idb} ==")
+    ha = (a["host"].get("spans") or {}).get("median_rounds_per_s")
+    hb = (b["host"].get("spans") or {}).get("median_rounds_per_s")
+    if ha and hb is not None:
+        print(f"  rounds/s: {ha:.2f} vs {hb:.2f} "
+              f"({(hb - ha) / ha:+.1%})")
+    for name in sorted(set(a["entries"]) | set(b["entries"])):
+        ea, eb = a["entries"].get(name), b["entries"].get(name)
+        if ea is None or eb is None:
+            print(f"  entry {name}: only in "
+                  f"{ida if eb is None else idb}")
+            continue
+        print(f"  entry {name}  (measured ms: A, B, delta)")
+        ra = dict(ea.get("stages") or {})
+        ra["unattributed"] = ea.get("unattributed_us") or 0.0
+        rb = dict(eb.get("stages") or {})
+        rb["unattributed"] = eb.get("unattributed_us") or 0.0
+        for stage in tuple(STAGES) + ("unattributed",):
+            ua = float(ra.get(stage, 0.0))
+            ub = float(rb.get(stage, 0.0))
+            if ua == ub == 0.0:
+                continue
+            moved = abs(ub - ua) > 0.25 * max(ua, ub)
+            mark = "   <-- differs" if moved else ""
+            print(f"    {stage:<17}{ua / 1e3:>13.3f}{ub / 1e3:>13.3f}"
+                  f"{(ub - ua) / 1e3:>+13.3f}{mark}")
+    return 0
+
+
 def cmd_selfcheck(reg, args):
     """CI self-check (tools/smoke.sh leg 6): two refreshes must agree
     (incremental refresh is idempotent over an unchanged store), every
@@ -707,6 +881,15 @@ def main(argv=None) -> int:
     sp.add_argument("b", nargs="?", default=None,
                     help="second run: diff B against the first")
     sp.set_defaults(fn=cmd_attribution)
+    sp = sub.add_parser("walls",
+                        help="measured per-stage wall tables from v10 "
+                             "'wall' events (--profile-every runs), "
+                             "with measured-vs-modeled ratios; a "
+                             "second query diffs two runs")
+    sp.add_argument("query")
+    sp.add_argument("b", nargs="?", default=None,
+                    help="second run: diff B against the first")
+    sp.set_defaults(fn=cmd_walls)
     sp = sub.add_parser("selfcheck",
                         help="CI: refresh idempotence + resolvability")
     sp.set_defaults(fn=cmd_selfcheck)

@@ -57,7 +57,15 @@ quarantine → protect → tier1_aggregate → tier2_aggregate → apply``
 plus the unattributed residual and the modeled coverage) and
 ``wire_bytes`` (one per run: bytes-per-round on every protocol seam —
 broadcast, client_update, tier1_to_tier2, secagg mask exchange /
-recovery, async delivery).
+recovery, async delivery); v10 adds ``wall`` — the measured-walls
+observatory (utils/walls.py, ``--profile-every``): one record per
+measured wall, either host-clock span/eval timing at the engine's
+eval-boundary fetch (``source='host'``: wall_s, rounds, rounds/s —
+no new host callbacks in-jit) or a profiler-trace capture booked
+onto the stage taxonomy (``source='trace'``: per-stage microseconds
++ unattributed residual summing exactly to wall_s, with op-event
+coverage riding along) — the runtime twin of v9's modeled
+``stage_cost``.
 Readers accept every version; older logs simply never carry the newer
 kinds, and a newer-only kind stamped with an older version is an
 emitter bug, rejected (``KIND_MIN_VERSION``).
@@ -75,8 +83,8 @@ from typing import Optional
 import numpy as np
 
 
-SCHEMA_VERSION = 9
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
+SCHEMA_VERSION = 10
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
 
 # kind -> required fields.  Producers: core/engine.py (round, eval, asr,
 # profile, stream, defense, attack, selection_hist via RunLogger).
@@ -179,6 +187,17 @@ EVENT_KINDS = {
     # tier1_to_tier2 seam reproduces the measured SPMD all_gather
     # collective_bytes == S·d·4)
     "wire_bytes": {"topology", "seams", "total_bytes"},
+    # --- v10: the measured-walls observatory (utils/walls.py) -----------
+    # one measured wall per record, emitted under --profile-every.
+    # source='host': host-clock timing at the engine's existing eval-
+    # boundary fetch (span wall + rounds + rounds/s, eval wall) — cheap,
+    # every span.  source='trace': one profiled span per K eval
+    # intervals, booked onto the stage taxonomy ('stages': stage -> us,
+    # plus 'unattributed_us'; the partition sums to wall_s exactly) with
+    # op-event 'coverage' riding along — the runtime twin of
+    # 'stage_cost', joined by 'name' for measured-vs-modeled ratios
+    # ('runs walls').
+    "wall": {"name", "source", "wall_s"},
 }
 
 # Minimum schema version per kind introduced after v1; an event carrying
@@ -188,7 +207,8 @@ KIND_MIN_VERSION = {"compile": 2, "cost": 2, "heartbeat": 2,
                     "lifecycle": 3, "registry": 4, "gate": 4,
                     "secagg": 5, "shard_selection": 6, "forensics": 6,
                     "async": 7, "campaign": 8,
-                    "stage_cost": 9, "wire_bytes": 9}
+                    "stage_cost": 9, "wire_bytes": 9,
+                    "wall": 10}
 
 # Back-compat alias (pre-v3 spelling used by external readers).
 V2_KINDS = {k for k, v in KIND_MIN_VERSION.items() if v == 2}

@@ -5,16 +5,48 @@ end (reference main.py:97; SURVEY.md §5 "tracing: absent").  Here every round
 phase (grads / attack / aggregate / eval) can be timed with a context-manager
 stopwatch that blocks on device completion, and a full XLA trace can be
 captured with ``jax.profiler`` around any region for TensorBoard/Perfetto.
+
+``device_trace`` is the backend-aware capture wrapper the measured-walls
+layer (utils/walls.py, ``--profile-every``) runs through: on the CPU
+backend a capture is always safe and always taken; on any other backend
+it is a no-op unless ``FL_TEST_TPU=1`` — the same gate the
+hardware-bound tests use, so harness code can wrap capture regions
+unconditionally without risking a TPU touch while the relay may be
+dead (CLAUDE.md).  ``ensure_op_profiling`` arms the XLA flag that makes
+CPU captures carry per-op events at all.
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from collections import defaultdict
 from typing import Optional
 
 import jax
+
+# The TFRT CPU runtime only emits per-op TraceMe annotations (one X
+# event per thunk, named by HLO instruction) when this debug flag is
+# set; without it a CPU capture carries runtime spans only and every
+# wall books to 'unattributed'.
+OP_TRACE_FLAG = "--xla_cpu_enable_xprof_traceme=true"
+
+
+def ensure_op_profiling() -> bool:
+    """Arm per-op CPU trace events by appending :data:`OP_TRACE_FLAG`
+    to ``XLA_FLAGS``.  XLA parses the env variable ONCE, at the first
+    compilation of the process — so this must run before anything is
+    compiled (cli.py calls it at --profile-every setup, tools set it at
+    main() entry; measured on this box: effective even though
+    sitecustomize imported jax long before).  Returns True when the
+    flag is present afterwards; callers that might be late (a warm
+    pytest process) still get a valid, fully-unattributed booking, not
+    a crash."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if OP_TRACE_FLAG not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + OP_TRACE_FLAG).strip()
+    return True
 
 
 class PhaseTimer:
@@ -63,3 +95,30 @@ def xla_trace(log_dir: Optional[str]):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: Optional[str], require_gate: bool = False):
+    """Backend-aware profiler capture around a block.
+
+    Capture runs when a ``log_dir`` is given AND either the backend is
+    CPU (always safe on this box) or ``FL_TEST_TPU=1`` (the explicit
+    hardware opt-in); any other combination is a no-op, so a capture
+    region can never be the thing that touches a TPU whose relay is
+    dead.  ``require_gate=True`` restores the stricter pre-walls
+    contract (no capture without FL_TEST_TPU, even on CPU) that
+    utils/trace_export.py pins for its callers.  The env gate is
+    checked before any jax attribute so the no-op paths never
+    initialize a backend."""
+    if not log_dir:
+        yield
+        return
+    gated = os.environ.get("FL_TEST_TPU") == "1"
+    if require_gate and not gated:
+        yield
+        return
+    if not gated and jax.default_backend() != "cpu":
+        yield
+        return
+    with xla_trace(log_dir):
+        yield

@@ -264,7 +264,7 @@ class RunRegistry:
         for k in ("status", "attempt", "last_round", "rounds_committed",
                   "updated", "exit_code", "checkpoint", "events",
                   "final_accuracy", "max_accuracy", "final_asr",
-                  "config_hash", "tag"):
+                  "rounds_per_s", "config_hash", "tag"):
             if k in manifest:
                 entry[k] = manifest[k]
         cfg = manifest.get("config")

@@ -27,7 +27,13 @@ directly:
   colluder-localization story as a timeline;
 - the end-of-run **profile** summary (PhaseTimer) is laid out as
   sequential "X" spans on a phases track (aggregates, not real
-  intervals — count/mean ride in args).
+  intervals — count/mean ride in args);
+- **wall** events (schema v10, --profile-every): each source='trace'
+  capture's measured stage walls become sequential "X" spans on a
+  "measured stages" track (aggregates over the profiled span, same
+  convention as the phases track — the relative widths are the
+  runtime attribution utils/walls.py booked), and the host-clock
+  span/eval walls become instants on the same track.
 
 ``device_trace`` is the opt-in REAL capture hook: under ``FL_TEST_TPU=1``
 it wraps ``jax.profiler`` start/stop trace (XLA-level, TensorBoard/
@@ -57,11 +63,13 @@ _TID_LIFECYCLE = 4
 _TID_FAULTS = 5
 _TID_PHASES = 6
 _TID_FORENSICS = 7
+_TID_WALLS = 8
 
 _TID_NAMES = {_TID_ROUNDS: "rounds", _TID_EVALS: "evals",
               _TID_COMPILES: "compiles", _TID_LIFECYCLE: "lifecycle",
               _TID_FAULTS: "faults", _TID_PHASES: "phases (aggregate)",
-              _TID_FORENSICS: "tier-2 forensics"}
+              _TID_FORENSICS: "tier-2 forensics",
+              _TID_WALLS: "measured stages (aggregate)"}
 
 _INSTANT_KINDS = {"eval": _TID_EVALS, "asr": _TID_EVALS,
                   "lifecycle": _TID_LIFECYCLE, "fault": _TID_FAULTS,
@@ -160,6 +168,36 @@ def events_to_trace(events, name: str = "run") -> dict:
                                        "mean_ms": row.get("mean_ms"),
                                        "aggregate": True}})
                 cursor += total
+        elif kind == "wall":
+            if e.get("source") == "trace":
+                # Measured stage walls (schema v10): laid end to end
+                # from the event's own timestamp — aggregates over the
+                # profiled span, not real intervals (args say so), but
+                # the relative widths ARE the measured attribution,
+                # the runtime twin of the phases track above.
+                cursor = float(t)
+                rows = dict(e.get("stages") or {})
+                ua = float(e.get("unattributed_us", 0.0) or 0.0)
+                if ua > 0:
+                    rows["unattributed"] = ua
+                for sname, us in rows.items():
+                    dur_s = float(us) / 1e6
+                    trace.append({"name": f"{e.get('name', '?')}:"
+                                          f"{sname}",
+                                  "ph": "X", "pid": pid,
+                                  "tid": _TID_WALLS, "ts": _us(cursor),
+                                  "dur": max(_us(dur_s), 1),
+                                  "args": {"measured_us": float(us),
+                                           "entry": e.get("name"),
+                                           "aggregate": True}})
+                    cursor += dur_s
+            else:
+                # Host-clock span/eval walls: instants on the same
+                # track (the payload carries wall_s / rounds_per_s).
+                trace.append({"name": f"wall:{e.get('name', '?')}",
+                              "ph": "i", "pid": pid, "tid": _TID_WALLS,
+                              "ts": _us(t), "s": "t",
+                              "args": _args_of(e)})
         elif kind == "shard_selection":
             # Hierarchical forensics (schema v6): the tier-2 rejection
             # attribution as a timeline — a counter of how many shard
@@ -277,10 +315,11 @@ def device_trace(log_dir: Optional[str]):
     TensorBoard/Perfetto capture in ``log_dir``.  Anywhere else — no
     log_dir, or no FL_TEST_TPU — it is a no-op, so callers can wrap
     capture regions unconditionally without ever touching a backend
-    whose relay may be dead (CLAUDE.md)."""
-    if not log_dir or os.environ.get("FL_TEST_TPU") != "1":
-        yield
-        return
-    from attacking_federate_learning_tpu.utils.profiling import xla_trace
-    with xla_trace(log_dir):
+    whose relay may be dead (CLAUDE.md).  The measured-walls layer
+    uses the CPU-safe variant (utils/profiling.py:device_trace); this
+    strictly-gated spelling is kept for its pre-walls callers."""
+    from attacking_federate_learning_tpu.utils.profiling import (
+        device_trace as _dt
+    )
+    with _dt(log_dir, require_gate=True):
         yield

@@ -436,6 +436,59 @@ def bench_impl_table(G, f, on_accel, rtt=0.0, iters=4):
     return rows
 
 
+def headline_walls(G, n, f, platform, reps=3):
+    """Measured per-stage walls for the headline Krum kernel: wrap it
+    in the tier1_aggregate stage scope, run a few profiled reps, and
+    book the capture onto the stage taxonomy against the compiled
+    program's own instruction map (utils/walls.py).  Returns the
+    summary dict for RESULT['walls'], or None when no capture is
+    possible on this backend (the caller drops the key rather than
+    recording zeros)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from attacking_federate_learning_tpu.defenses.kernels import krum
+    from attacking_federate_learning_tpu.utils import walls
+    from attacking_federate_learning_tpu.utils.costs import (
+        compiled_cost_facts, stage_attribution, stage_scope
+    )
+    from attacking_federate_learning_tpu.utils.profiling import (
+        device_trace
+    )
+
+    def staged(g):
+        with stage_scope("tier1_aggregate"):
+            return krum(g, n, f)
+
+    jitted = jax.jit(staged)
+    compiled = jitted.lower(G).compile()
+    fetch1(jitted(G))                                 # warm
+    wdir = tempfile.mkdtemp(prefix="bench_walls_")
+    try:
+        with device_trace(wdir):
+            for _ in range(reps):
+                fetch1(jitted(G))
+        rec = walls.book_trace(wdir, compiled.as_text(),
+                               name="krum_staged", platform=platform)
+    finally:
+        shutil.rmtree(wdir, ignore_errors=True)
+    if rec is None or rec.coverage.get("op_events", 0) == 0:
+        return None
+    att = stage_attribution(compiled.as_text(),
+                            compiled_cost_facts(compiled))
+    modeled = {"stages": {s: {"flops": v["flops"]}
+                          for s, v in att["stages"].items()},
+               "unattributed": {"flops": att["unattributed"]["flops"]}}
+    agg = {"stages": rec.stages, "unattributed_us": rec.unattributed_us}
+    return {"reps": reps,
+            "stages": {s: round(v, 3) for s, v in rec.stages.items()},
+            "unattributed_us": round(rec.unattributed_us, 3),
+            "op_time_fraction": rec.coverage.get("op_time_fraction"),
+            "vs_modeled": walls.measured_vs_modeled(agg, modeled)}
+
+
 def main():
     from attacking_federate_learning_tpu.utils.backend import (
         enable_compile_cache, ensure_live_backend,
@@ -449,6 +502,15 @@ def main():
     # BENCH tail; a REAL cross-host mismatch (ISA features named)
     # still passes through verbatim.
     install_aot_warning_collapse()
+    # Op-level trace events need the xprof flag in XLA_FLAGS before
+    # the FIRST compile of the process (XLA parses the env once) — set
+    # here so the headline measured-walls capture can book per-op
+    # (utils/profiling.py:ensure_op_profiling; harmless everywhere
+    # else).
+    from attacking_federate_learning_tpu.utils.profiling import (
+        ensure_op_profiling
+    )
+    ensure_op_profiling()
     ensure_live_backend()
     enable_compile_cache()
     import functools
@@ -565,6 +627,29 @@ def main():
             recap(f"  wire ledger [flat n={n}]: "
                   f"{RESULT['wire']['total_bytes'] / 1e6:.1f} MB/round "
                   f"over {len(RESULT['wire']['seams'])} seams")
+            # Measured stage walls for the same headline kernel
+            # (ISSUE 16): a few profiled reps booked onto the stage
+            # taxonomy (utils/walls.py) next to the modeled cost, so
+            # one BENCH record carries modeled AND measured shares.
+            # Distinct from RESULT['phase_timing'] (PhaseTimer: host
+            # walls of whole bench phases) — this is device op time
+            # within the kernel.  Skips cleanly (no 'walls' key) when
+            # the capture is unavailable: non-CPU backend without the
+            # FL_TEST_TPU gate, or the xprof flag missed this
+            # process's first compile.
+            wall_summary = headline_walls(G, n, f, dev.platform)
+            if wall_summary is not None:
+                RESULT["walls"] = wall_summary
+                top = max(wall_summary["stages"],
+                          key=lambda s: wall_summary["stages"][s],
+                          default="-")
+                recap(f"  measured walls [krum_staged]: "
+                      + "  ".join(
+                          f"{s}={us / 1e3:.1f}ms" for s, us in
+                          wall_summary["stages"].items())
+                      + f"  unattributed="
+                        f"{wall_summary['unattributed_us'] / 1e3:.1f}ms"
+                        f"  [top: {top}]")
         except Exception as e:
             log(f"  (static cost analysis unavailable: "
                 f"{type(e).__name__}: {e})")

@@ -298,6 +298,12 @@ def test_schema_roundtrip_every_engine_kind(tmp_path):
         # tests/test_campaign.py).
         logger.record(kind="campaign", campaign="c_test",
                       phase="cell_done", cell="x", rc=0)
+        # v10: the measured-wall kind (--profile-every runs emit these
+        # from core/engine.py's fetch boundary; synthesized here — the
+        # real emission path, both host and trace sources, is covered
+        # in tests/test_walls.py).
+        logger.record(kind="wall", name="fused_span", source="host",
+                      wall_s=0.125, rounds=2)
         # v3: a journaled run emits the 'lifecycle' kind from the
         # engine itself (start/complete; utils/lifecycle.py) — and, as
         # of v4, the run-finish 'registry' stamp.

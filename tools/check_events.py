@@ -27,7 +27,12 @@ campaigns/scheduler.py — plus v9's observability kinds:
 'wire_bytes' per-seam wire ledgers, both emitted by --cost-report
 runs via utils/costs.py:CompileLedger.emit; with telemetry/reporting
 off neither kind may appear, the invariant
-tests/test_costs.py pins).  An
+tests/test_costs.py pins — plus v10's 'wall' kind: measured wall
+telemetry from --profile-every runs — source='host' per-span/per-eval
+host-clock walls from core/engine.py's fetch boundary, and
+source='trace' per-stage booked walls from a jax.profiler capture,
+utils/walls.py, whose stages + unattributed_us partition the booked
+total exactly).  An
 event stamped with a
 version this reader does not know is reported as "produced by a newer
 writer" — a clear per-line error, never a KeyError — and a newer-only

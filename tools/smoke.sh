@@ -4,18 +4,19 @@
 # pallas fusion proof + stage/wire-ledger stageproof) +
 # science gate + registry selfcheck + hierarchical-aggregation smoke +
 # secure-aggregation smoke + hierarchical-telemetry/forensics smoke +
-# asynchronous-rounds smoke + campaign-engine kill/resume smoke.
+# asynchronous-rounds smoke + campaign-engine kill/resume smoke +
+# measured-walls smoke (profiled run, runs walls, wall gate).
 #
-#   bash tools/smoke.sh            # all eleven, CPU-pinned
+#   bash tools/smoke.sh            # all twelve, CPU-pinned
 #   bash tools/smoke.sh --fast     # skip the fault + crash matrices
 #                                  # (the two slowest legs)
 #
 # Legs (each independently CI-wired through tests/ as well):
 #   1. tools/check_events.py over every run JSONL in logs/ (schema
-#      v1-v9: round/eval/.../fault, compile/cost/heartbeat, lifecycle,
+#      v1-v10: round/eval/.../fault, compile/cost/heartbeat, lifecycle,
 #      registry/gate, secagg, shard_selection/forensics, async,
-#      campaign, stage_cost/wire_bytes) — skipped when logs/ has no
-#      .jsonl yet;
+#      campaign, stage_cost/wire_bytes, wall) — skipped when logs/ has
+#      no .jsonl yet;
 #   2. tools/fault_matrix.py — 5-round fault x defense sweep, emitted
 #      'fault' events diffed against the host replay of the schedule,
 #      plus the dropout x async-buffer leg (async + fault events
@@ -60,7 +61,14 @@
 #      campaign journal audits exactly-once, runs/index.jsonl carries
 #      zero duplicate run stamps, check_events validates the v8
 #      'campaign' event stream, and 'runs campaign <id>' renders the
-#      defense x attack table from the registry.
+#      defense x attack table from the registry;
+#  12. measured-walls smoke — a journaled 5-round flat x Krum run with
+#      --profile-every 1 (schema-v10 'wall' events: host span/eval
+#      walls + per-stage trace bookings, utils/walls.py), check_events
+#      over its private log, 'runs walls' exit-0 on the run, and the
+#      noise-banded wall gate's self-consistency: a fresh --update
+#      baseline in a temp dir must gate clean at k=3
+#      (tools/wall_gate.py).
 #
 # Exit: nonzero if any leg fails.  Always CPU (the gates' baselines are
 # CPU artifacts, and the matrices must not touch a TPU capture).
@@ -75,33 +83,33 @@ fail=0
 shopt -s nullglob
 jsonls=(logs/*.jsonl)
 if [ ${#jsonls[@]} -gt 0 ]; then
-    echo "== smoke 1/11: check_events (${#jsonls[@]} logs) =="
+    echo "== smoke 1/12: check_events (${#jsonls[@]} logs) =="
     python tools/check_events.py "${jsonls[@]}" || fail=1
 else
-    echo "== smoke 1/11: check_events — no logs/*.jsonl yet, skipped =="
+    echo "== smoke 1/12: check_events — no logs/*.jsonl yet, skipped =="
 fi
 
 crash_work=""
 if [ "${1:-}" != "--fast" ]; then
-    echo "== smoke 2/11: fault_matrix =="
+    echo "== smoke 2/12: fault_matrix =="
     python tools/fault_matrix.py || fail=1
-    echo "== smoke 3/11: crash_matrix (supervised preempt/resume) =="
+    echo "== smoke 3/12: crash_matrix (supervised preempt/resume) =="
     # Keep the matrix's run stores: leg 6 registry-checks them.
     crash_work="$(mktemp -d -t crash_matrix_XXXXXX)"
     python tools/crash_matrix.py --workdir "$crash_work" || fail=1
 else
-    echo "== smoke 2/11: fault_matrix — skipped (--fast) =="
-    echo "== smoke 3/11: crash_matrix — skipped (--fast) =="
+    echo "== smoke 2/12: fault_matrix — skipped (--fast) =="
+    echo "== smoke 3/12: crash_matrix — skipped (--fast) =="
 fi
 
-echo "== smoke 4/11: perf_gate (+ memproof + wireproof + pallasproof"
+echo "== smoke 4/12: perf_gate (+ memproof + wireproof + pallasproof"
 echo "   + shardproof + stageproof) =="
 python tools/perf_gate.py --memproof || fail=1
 
-echo "== smoke 5/11: science_gate (behavioral drift) =="
+echo "== smoke 5/12: science_gate (behavioral drift) =="
 python tools/science_gate.py || fail=1
 
-echo "== smoke 6/11: runs selfcheck (registry) =="
+echo "== smoke 6/12: runs selfcheck (registry) =="
 python -m attacking_federate_learning_tpu.cli runs selfcheck || fail=1
 if [ -n "$crash_work" ]; then
     # The registry over the crash matrix's preempt/resume artifacts:
@@ -118,7 +126,7 @@ if [ -n "$crash_work" ]; then
     rm -rf "$crash_work"
 fi
 
-echo "== smoke 7/11: hierarchical aggregation (journaled, audited) =="
+echo "== smoke 7/12: hierarchical aggregation (journaled, audited) =="
 hier_work="$(mktemp -d -t hier_smoke_XXXXXX)"
 for def in Krum TrimmedMean; do
     python -m attacking_federate_learning_tpu.cli \
@@ -144,7 +152,7 @@ sys.exit(bad)
 PY
 rm -rf "$hier_work"
 
-echo "== smoke 8/11: secure aggregation (journaled, audited) =="
+echo "== smoke 8/12: secure aggregation (journaled, audited) =="
 sa_work="$(mktemp -d -t secagg_smoke_XXXXXX)"
 # vanilla: one dropout-rate high enough that the 5-round seeded run is
 # guaranteed (and pinned by the audit below) to include at least one
@@ -193,7 +201,7 @@ sys.exit(bad)
 PY
 rm -rf "$sa_work"
 
-echo "== smoke 9/11: hierarchical telemetry + forensics (journaled) =="
+echo "== smoke 9/12: hierarchical telemetry + forensics (journaled) =="
 fx_work="$(mktemp -d -t hier_tele_smoke_XXXXXX)"
 # 5-round journaled hierarchical x Krum run with --telemetry: the run
 # must emit one schema-v6 'shard_selection' event per round.
@@ -230,7 +238,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     trace hier_tele_smoke -o "$fx_work/trace.json" || fail=1
 rm -rf "$fx_work"
 
-echo "== smoke 10/11: asynchronous rounds (journaled, audited) =="
+echo "== smoke 10/12: asynchronous rounds (journaled, audited) =="
 as_work="$(mktemp -d -t async_smoke_XXXXXX)"
 # 5-round journaled FedBuff runs: k=8 of n=12 aggregated per applied
 # round, staleness bound 2, poly weighting, Krum + TrimmedMean.
@@ -280,7 +288,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     async async_Krum_smoke || fail=1
 rm -rf "$as_work"
 
-echo "== smoke 11/11: campaign engine (kill + resume, audited) =="
+echo "== smoke 11/12: campaign engine (kill + resume, audited) =="
 ce_work="$(mktemp -d -t campaign_smoke_XXXXXX)"
 cat > "$ce_work/spec.json" <<SPEC
 {"name": "smoke",
@@ -331,6 +339,52 @@ python -m attacking_federate_learning_tpu.cli runs \
     --run-dir "$ce_work/runs" --bench '' --progress '' \
     campaign "$camp_id" || fail=1
 rm -rf "$ce_work"
+
+echo "== smoke 12/12: measured walls (profiled run + wall gate) =="
+wl_work="$(mktemp -d -t walls_smoke_XXXXXX)"
+# 5-round journaled flat x Krum with every eval interval profiled: the
+# engine books each span capture onto the stage taxonomy and emits
+# schema-v10 'wall' events next to the --cost-report stage_cost twins.
+python -m attacking_federate_learning_tpu.cli \
+    -d Krum -s SYNTH_MNIST -n 12 -m 0.25 -c 16 -e 5 \
+    --synth-train 256 --synth-test 64 \
+    --profile-every 1 --cost-report \
+    --journal --run-id walls_smoke --no-checkpoint \
+    --log-dir "$wl_work/logs" --run-dir "$wl_work/runs" \
+    > /dev/null || fail=1
+# The private log validates (v10 'wall' events included) and carries
+# both wall sources (host span/eval clocks + trace bookings).
+python tools/check_events.py "$wl_work/logs/walls_smoke.jsonl" || fail=1
+python - "$wl_work" <<'PY' || fail=1
+import json, os, sys
+events = [json.loads(line) for line in
+          open(os.path.join(sys.argv[1], "logs", "walls_smoke.jsonl"))]
+wl = [e for e in events if e.get("kind") == "wall"]
+src = {e.get("source") for e in wl}
+traced = [e for e in wl if e.get("source") == "trace"]
+exact = all(
+    abs(sum(e["stages"].values()) + e["unattributed_us"]
+        - e["wall_s"] * 1e6) <= 1.0 for e in traced)
+ok = (bool(wl) and src == {"host", "trace"}
+      and all(e.get("v") == 10 for e in wl)
+      and all(e["coverage"]["op_events"] > 0 for e in traced) and exact)
+print(f"  wall events: {len(wl)} ({len(traced)} trace-booked, "
+      f"partition {'exact' if exact else 'BROKEN'}) "
+      f"({'ok' if ok else 'FAIL'})")
+sys.exit(0 if ok else 1)
+PY
+# The registry verb renders the measured/modeled tables (exit 0).
+python -m attacking_federate_learning_tpu.cli runs \
+    --run-dir "$wl_work/runs" --bench '' --progress '' \
+    walls walls_smoke || fail=1
+# Wall-gate self-consistency: a freshly generated baseline must gate
+# clean at k=3 (median + MAD noise bands, tools/wall_gate.py) —
+# checked in a temp dir so the checked-in WALL_BASELINE.json is never
+# clobbered by the smoke.
+python tools/wall_gate.py --update --baseline "$wl_work/WALL_BASELINE.json" \
+    > /dev/null || fail=1
+python tools/wall_gate.py --baseline "$wl_work/WALL_BASELINE.json" || fail=1
+rm -rf "$wl_work"
 
 if [ $fail -ne 0 ]; then
     echo "SMOKE FAILED"

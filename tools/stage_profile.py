@@ -120,6 +120,47 @@ def run_cell(tag: str, overrides: dict, rounds: int,
     rec["rounds"] = rounds
     rec["wall_s"] = round(time.perf_counter() - t0, 3)
     rec["trace_dir"] = trace_dir
+
+    if trace_dir:
+        # ISSUE 16 cross-check: book the capture just taken against
+        # the SAME compiled program the static attribution above
+        # priced (utils/walls.py — instruction-name join).  The two
+        # ledgers must tell one story: booked partition exact, booked
+        # op time inside the host wall, and every stage the static
+        # side attributes flops to either appears in the booking or is
+        # explicitly absent (a capture missing op events — flag unset
+        # or TPU-gated no-op — reports walls_verdict='no-op-events'
+        # loudly instead of a vacuous pass).
+        from attacking_federate_learning_tpu.utils import walls
+        wrec = walls.book_trace(trace_dir, compiled.as_text(),
+                                name=tag,
+                                platform=rec["platform"],
+                                rounds=rounds)
+        if wrec is None:
+            rec["walls_verdict"] = "no-trace-file"
+        elif wrec.coverage.get("op_events", 0) == 0:
+            rec["walls_verdict"] = "no-op-events"
+        else:
+            wrec.check()                         # exact partition
+            rec["walls"] = {
+                "stages": {s: round(v, 3)
+                           for s, v in wrec.stages.items()},
+                "unattributed_us": round(wrec.unattributed_us, 3),
+                "op_time_fraction":
+                    wrec.coverage.get("op_time_fraction"),
+            }
+            booked_s = wrec.total_us / 1e6
+            problems = []
+            if booked_s > rec["wall_s"] * 1.05:
+                problems.append(
+                    f"booked {booked_s:.3f}s exceeds host wall "
+                    f"{rec['wall_s']:.3f}s")
+            for s, fl in rec["stage_flops"].items():
+                if fl > 0 and s not in wrec.stages:
+                    problems.append(f"stage {s} carries modeled flops "
+                                    f"but booked no wall time")
+            rec["walls_verdict"] = ("ok" if not problems
+                                    else "; ".join(problems))
     return rec
 
 
@@ -137,8 +178,18 @@ def main(argv=None) -> int:
     if args.rehearse:
         _force_rehearse_env()
 
+    # Op-level trace events need the xprof flag before this process's
+    # FIRST compile (XLA parses XLA_FLAGS once); without it the
+    # booking cross-check reports walls_verdict='no-op-events'.
+    from attacking_federate_learning_tpu.utils.profiling import (
+        ensure_op_profiling
+    )
+    ensure_op_profiling()
+
     failed = False
+    t_start = time.perf_counter()
     for tag, overrides in CELLS.items():
+        t_cell = time.perf_counter()
         try:
             rec = run_cell(tag, overrides, args.rounds,
                            args.trace_dir or None)
@@ -148,6 +199,13 @@ def main(argv=None) -> int:
                    f"{type(e).__name__}: {e}"}
             failed = True
         print(json.dumps(rec), flush=True)
+        # Same [budget] convention as tpu_capture.sh, from inside the
+        # tool — a stalled cell is visible in the step log even when
+        # the outer timeout kills us before the shell's budget line.
+        print(f"[budget] stage_profile.{tag}: "
+              f"{time.perf_counter() - t_cell:.1f}s (cum "
+              f"{time.perf_counter() - t_start:.1f}s)",
+              file=sys.stderr, flush=True)
     return 1 if failed else 0
 
 

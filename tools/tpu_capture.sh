@@ -177,6 +177,9 @@ echo "== step 2.7: stage-ledger profile (stage scopes live, ISSUE 15) =="
 # named_scope annotations live: static per-stage attribution + wire
 # ledger per cell, plus a jax.profiler device trace whose op breakdown
 # carries the same stage tokens (the on-TPU face of --stageproof).
+# Since ISSUE 16 each cell also books its own capture through
+# utils/walls.py and banks a walls_verdict (partition exact, booked
+# time inside the host wall) next to the static row.
 "${SUP[@]}" timeout 900 python tools/stage_profile.py \
   ${SP_ARGS[@]+"${SP_ARGS[@]}"} --trace-dir "$OUT/stage_trace_$STAMP" \
   >"$OUT/stage_$STAMP.jsonl" 2>>"$OUT/stage_$STAMP.log" || true
