@@ -129,7 +129,8 @@ def composition_reject_reason(overrides: dict,
     passes every *pure* engine-init check — the same check functions
     the engine calls (defenses/kernels.py check_defense_args /
     check_tier2_args, core/faults.py check_fault_support,
-    core/async_rounds.py check_async_support) plus the config
+    core/async_rounds.py check_async_support,
+    core/population.py check_traffic_support) plus the config
     dataclass's own ``__post_init__`` rejections — or the rejection
     message otherwise.  tests/test_campaign.py pins agreement between
     this pre-check and real construction for the known-invalid matrix,
@@ -269,6 +270,12 @@ def validate_composition(cfg: ExperimentConfig,
         )
 
         check_fault_support(cfg)
+    if cfg.traffic is not None and cfg.traffic.enabled:
+        from attacking_federate_learning_tpu.core.population import (
+            check_traffic_support
+        )
+
+        check_traffic_support(cfg)
 
 
 # ---------------------------------------------------------------------------

@@ -351,6 +351,65 @@ def build_parser() -> argparse.ArgumentParser:
                         "finite bit-scaled rows ('scale' — what the "
                         "robust defense / divergence watchdog must "
                         "absorb)")
+    p.add_argument("--traffic-population", default=0, type=int,
+                   metavar="P",
+                   help="population & traffic engine (core/population.py): "
+                        "sample each round's cohort from a registry of P "
+                        "clients (P >> cohort; per-client state is lazy — "
+                        "no (P,)-sized tensor ever exists) with diurnal "
+                        "arrival, correlated on/off churn, heavy-tail "
+                        "async latencies, and a defense-validity watchdog "
+                        "that degrades under-filled rounds through "
+                        "remask -> fallback defense -> hold, each "
+                        "decision a v11 'traffic' event; 0 = off (the "
+                        "legacy --participation draw)")
+    p.add_argument("--traffic-rate", default=0.9, type=float, metavar="R",
+                   help="base per-round arrival rate (scaled per client "
+                        "by its reliability profile)")
+    p.add_argument("--traffic-diurnal-amp", default=0.0, type=float,
+                   metavar="A",
+                   help="diurnal modulation amplitude in [0,1]: rate(t) = "
+                        "R*(1 + A*sin(2*pi*t/period))")
+    p.add_argument("--traffic-diurnal-period", default=24, type=int,
+                   metavar="T", help="diurnal period in rounds")
+    p.add_argument("--traffic-churn-dwell", default=4, type=int,
+                   metavar="K",
+                   help="mean on/off churn episode length in rounds "
+                        "(per-client Markov-style alternating renewal: "
+                        "one availability draw per K-round block)")
+    p.add_argument("--traffic-latency-scale", default=1.0, type=float,
+                   metavar="S",
+                   help="heavy-tail straggler latency scale (async "
+                        "engine: Pareto arrival delay replaces the "
+                        "uniform 0..D draw)")
+    p.add_argument("--traffic-latency-tail", default=1.5, type=float,
+                   metavar="A", help="Pareto tail exponent (smaller = "
+                                     "heavier straggler tail)")
+    p.add_argument("--traffic-sybil-period", default=0, type=int,
+                   metavar="T",
+                   help="time-correlated colluder arrival: colluders "
+                        "arrive only in a window of --traffic-sybil-width "
+                        "rounds every T rounds, boosted so their AVERAGE "
+                        "arrival mass matches uniform (fixed average f — "
+                        "participation as an attack axis); 0 = uniform "
+                        "colluder arrival")
+    p.add_argument("--traffic-sybil-width", default=1, type=int,
+                   metavar="W", help="sybil burst window width in rounds")
+    p.add_argument("--traffic-fallback", default="Median",
+                   choices=["Median", "TrimmedMean", "NoDefense"],
+                   help="ladder step 2: the bounds-valid defense an "
+                        "under-filled round falls back to when the "
+                        "configured defense's validity bound breaks")
+    p.add_argument("--traffic-min-cohort", default=1, type=int,
+                   metavar="M",
+                   help="floor on arrived clients below which the round "
+                        "degrades regardless of defense bounds")
+    p.add_argument("--traffic-seed", default=None, type=int,
+                   metavar="SEED",
+                   help="traffic schedule seed override (default: derived "
+                        "from the experiment seed) — lets a campaign "
+                        "sweep traffic realizations without moving the "
+                        "data/init/attack draws")
     p.add_argument("--profile", action="store_true",
                    help="accumulate per-phase (round/eval) wall-clock and "
                         "record it in the JSONL log")
@@ -415,8 +474,24 @@ def config_from_args(args) -> ExperimentConfig:
                                corrupt=args.fault_corrupt,
                                straggler_delay=args.fault_straggler_delay,
                                corrupt_mode=args.fault_corrupt_mode)
+    traffic = None
+    if args.traffic_population > 0:
+        traffic = C.TrafficConfig(
+            population=args.traffic_population,
+            rate=args.traffic_rate,
+            diurnal_amp=args.traffic_diurnal_amp,
+            diurnal_period=args.traffic_diurnal_period,
+            churn_dwell=args.traffic_churn_dwell,
+            latency_scale=args.traffic_latency_scale,
+            latency_tail=args.traffic_latency_tail,
+            sybil_burst_period=args.traffic_sybil_period,
+            sybil_burst_width=args.traffic_sybil_width,
+            fallback_defense=args.traffic_fallback,
+            min_cohort=args.traffic_min_cohort,
+            seed=args.traffic_seed)
     return ExperimentConfig(
         faults=faults,
+        traffic=traffic,
         checkpoint_every=args.checkpoint_every,
         users_count=args.users_count,
         mal_prop=args.mal_prop,

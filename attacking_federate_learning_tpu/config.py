@@ -102,6 +102,99 @@ class FaultConfig:
 
 
 @dataclasses.dataclass
+class TrafficConfig:
+    """Population & traffic model (core/population.py).
+
+    ``population`` > 0 turns the subsystem on: each round's cohort is
+    sampled from a registry of P clients whose per-client persistent
+    state (data-shard archetype, femnist-style transform, reliability,
+    churn dwell, latency profile) is derived lazily from counter-based
+    PRNG streams — never materialized as a (P,)-sized tensor.  The
+    arrival process is a diurnal-modulated base rate with per-client
+    blockwise on/off churn (correlated dropout episodes of ~churn_dwell
+    rounds) and heavy-tail (Pareto ``latency_tail``) straggler
+    latencies feeding the async delivery ring.  The schedule is a pure
+    function of ``(TrafficConfig, seed, round)``: replayable on host
+    (population.replay_traffic), resume-exact with no carried state.
+
+    The sybil burst window makes participation an attack axis: with
+    ``sybil_burst_period`` > 0 colluders arrive only in the first
+    ``sybil_burst_width`` rounds of each period, boosted by
+    period/width so the AVERAGE arrived-colluder mass matches the
+    uniform profile (fixed average f).
+
+    Robustness half: when churn under-fills a round, the
+    defense-validity watchdog degrades through a declared ladder —
+    re-mask the configured defense to the arrived sub-cohort while its
+    bound holds (Krum m_eff >= 2f+3, Bulyan >= 4f+3), else run
+    ``fallback_defense``, else hold the round as a no-op — each
+    decision a versioned 'traffic' event (schema v11), never a crash
+    or a silent invalid aggregate.
+    """
+
+    population: int = 0          # P registered clients; 0 = disabled
+    rate: float = 0.9            # base per-round arrival probability scale
+    diurnal_amp: float = 0.0     # rate modulation amplitude in [0, 1]
+    diurnal_period: int = 24     # rounds per diurnal cycle
+    reliability_lo: float = 0.6  # per-client reliability spread
+    reliability_hi: float = 0.95
+    churn_dwell: int = 4         # mean on/off episode length (rounds)
+    latency_scale: float = 1.0   # async delay scale (rounds)
+    latency_tail: float = 1.5    # Pareto tail index (smaller = heavier)
+    sybil_burst_period: int = 0  # 0 = colluders arrive like honest clients
+    sybil_burst_width: int = 1   # rounds of each period colluders arrive in
+    fallback_defense: str = "Median"  # ladder step 2 kernel
+    min_cohort: int = 1          # hold below this many arrivals regardless
+    seed: Optional[int] = None   # None -> derived from the experiment seed
+
+    def __post_init__(self):
+        if self.population < 0:
+            raise ValueError(
+                f"traffic population must be >= 0, got {self.population}")
+        if self.rate <= 0:
+            raise ValueError(f"traffic rate must be > 0, got {self.rate}")
+        if not (0.0 <= self.diurnal_amp <= 1.0):
+            raise ValueError(
+                f"diurnal_amp must be in [0, 1], got {self.diurnal_amp}")
+        if self.diurnal_period < 1:
+            raise ValueError(
+                f"diurnal_period must be >= 1, got {self.diurnal_period}")
+        if not (0.0 < self.reliability_lo <= self.reliability_hi <= 1.0):
+            raise ValueError(
+                f"need 0 < reliability_lo <= reliability_hi <= 1, got "
+                f"{self.reliability_lo}/{self.reliability_hi}")
+        if self.churn_dwell < 1:
+            raise ValueError(
+                f"churn_dwell must be >= 1, got {self.churn_dwell}")
+        if self.latency_scale <= 0 or self.latency_tail <= 0:
+            raise ValueError(
+                f"latency_scale and latency_tail must be > 0, got "
+                f"{self.latency_scale}/{self.latency_tail}")
+        if self.sybil_burst_period < 0:
+            raise ValueError(
+                f"sybil_burst_period must be >= 0, got "
+                f"{self.sybil_burst_period}")
+        if self.sybil_burst_period > 0 and not (
+                1 <= self.sybil_burst_width <= self.sybil_burst_period):
+            raise ValueError(
+                f"sybil_burst_width must be in [1, period="
+                f"{self.sybil_burst_period}], got {self.sybil_burst_width}")
+        if self.fallback_defense not in ("Median", "TrimmedMean",
+                                         "NoDefense"):
+            raise ValueError(
+                f"fallback_defense must be 'Median', 'TrimmedMean' or "
+                f"'NoDefense' (the bounds-valid ladder kernels), got "
+                f"{self.fallback_defense!r}")
+        if self.min_cohort < 1:
+            raise ValueError(
+                f"min_cohort must be >= 1, got {self.min_cohort}")
+
+    @property
+    def enabled(self) -> bool:
+        return self.population > 0
+
+
+@dataclasses.dataclass
 class ExperimentConfig:
     # --- topology -------------------------------------------------------
     users_count: int = 10            # reference main.py:118
@@ -401,6 +494,16 @@ class ExperimentConfig:
     # > 0 turns on in-jit deterministic fault injection + the
     # pre-aggregation quarantine mask + the divergence watchdog.
     faults: Optional[FaultConfig] = None
+    # --- population & traffic (core/population.py; ARCHITECTURE.md) -----
+    # None (the default) is the resident-cohort reference path: every
+    # compiled round program is bit-identical to the pre-population one.
+    # A TrafficConfig (or an equivalent dict, coerced below) with
+    # population > 0 samples each round's cohort from the lazy client
+    # registry, injects correlated churn + the defense-validity
+    # degradation ladder (flat), draws async arrival delay from the
+    # latency profile (async), and resamples megabatch slots per round
+    # (hierarchical).
+    traffic: Optional["TrafficConfig"] = None
     # Auto-checkpoint cadence in rounds (0 = off): the engine writes a
     # rotated, atomically-replaced checkpoint-auto-<round>.npz every N
     # rounds (utils/checkpoint.py) — the rollback target for the
@@ -638,6 +741,10 @@ class ExperimentConfig:
             # Checkpoint-JSON round trips and kwargs-style callers hand
             # a plain dict; coerce so every consumer sees a FaultConfig.
             self.faults = FaultConfig(**self.faults)
+        if isinstance(self.traffic, dict):
+            # Same coercion seam as faults: journal/checkpoint JSON and
+            # campaign specs hand plain dicts.
+            self.traffic = TrafficConfig(**self.traffic)
         if self.checkpoint_every < 0:
             raise ValueError(
                 f"checkpoint_every must be >= 0, got "

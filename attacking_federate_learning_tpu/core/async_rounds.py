@@ -124,7 +124,7 @@ def init_async_state(spec: AsyncSpec, m: int, d: int):
 
 
 def draw_delays(key, t, m, m_mal, spec: AsyncSpec, faults=None,
-                fkey=None):
+                fkey=None, latency=None):
     """The round-t arrival schedule: ``(delay, drop, corrupt)``.
 
     ``delay`` (m,) int32 in [0, depth): uniform per (client, round),
@@ -139,9 +139,23 @@ def draw_delays(key, t, m, m_mal, spec: AsyncSpec, faults=None,
     core/faults.py:fault_key, defaulting to ``key``), so the injected
     schedule is identical to the sync path's and the host replay
     tools/fault_matrix.py validates against stays shared.
+
+    ``latency`` (traffic engine, core/population.py): an optional
+    ``(scales, tail)`` pair — per-cohort-slot heavy-tail Pareto scales
+    and the shared tail exponent — that replaces the uniform draw with
+    a discretized Pareto delay (still pure in ``(key, t)``; same
+    clipping to the ring depth).  None is the legacy uniform draw,
+    byte-identical.
     """
     kt = jax.random.fold_in(key, t)
-    delay = jax.random.randint(kt, (m,), 0, spec.depth)
+    if latency is not None:
+        from attacking_federate_learning_tpu.core.population import (
+            traffic_delays
+        )
+        scales, tail = latency
+        delay = traffic_delays(key, t, scales, tail, spec.depth)
+    else:
+        delay = jax.random.randint(kt, (m,), 0, spec.depth)
     if faults is not None:
         drop, stale, corrupt = fault_masks(
             key if fkey is None else fkey, t, m, m_mal, faults)
@@ -175,7 +189,7 @@ def staleness_weights(staleness, delivered, weighting: str):
 
 
 def async_step(grads, t, key, spec: AsyncSpec, state, m_mal,
-               faults=None, fkey=None):
+               faults=None, fkey=None, latency=None):
     """One async round against the submitted (m, d) matrix.
 
     Submits round-t updates into the ring at their drawn arrival slots,
@@ -203,7 +217,7 @@ def async_step(grads, t, key, spec: AsyncSpec, state, m_mal,
     D, m = spec.depth, grads.shape[0]
     k = min(spec.buffer, m)
     delay, drop, corrupt = draw_delays(key, t, m, m_mal, spec, faults,
-                                       fkey)
+                                       fkey, latency)
 
     submitted = grads.astype(jnp.float32)
     stats = {}
@@ -319,6 +333,15 @@ def replay_schedule(cfg, m, m_mal, epochs, timed=False):
     if faults is not None:
         from attacking_federate_learning_tpu.core.faults import fault_key
         fkey = fault_key(cfg)
+    latency = None
+    tr = getattr(cfg, "traffic", None)
+    if tr is not None and tr.enabled:
+        # Traffic engine: the replay must draw the same heavy-tail
+        # latency delays the device ring does (core/population.py).
+        from attacking_federate_learning_tpu.core.population import (
+            async_latency_for_cfg
+        )
+        latency = async_latency_for_cfg(cfg, m)
     occ = np.zeros((D, m), bool)
     birth = np.zeros((D, m), np.int64)
     pocc = np.zeros((m,), bool)
@@ -327,7 +350,7 @@ def replay_schedule(cfg, m, m_mal, epochs, timed=False):
     for t in range(epochs):
         delay, drop, _ = (np.asarray(x) for x in
                           draw_delays(key, t, m, m_mal, spec, faults,
-                                      fkey))
+                                      fkey, latency))
         slots = (t + delay) % D
         superseded = int(occ[slots, np.arange(m)][~drop].sum())
         write = ~drop

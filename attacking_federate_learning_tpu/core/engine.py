@@ -168,6 +168,35 @@ class FederatedExperiment:
             self._fault_key = fault_key(cfg)
         else:
             self.faults = None
+        # Population & traffic engine (core/population.py): None is the
+        # resident-cohort reference path — no registry, no schedule, no
+        # arrival mask; the compiled round program is bit-identical to
+        # the pre-population one.  The registry is LAZY: it holds
+        # scalars only, so engine memory scales with the cohort m
+        # however large cfg.traffic.population grows.
+        if cfg.traffic is not None and cfg.traffic.enabled:
+            from attacking_federate_learning_tpu.core.population import (
+                PopulationRegistry, check_traffic_support, traffic_key
+            )
+            check_traffic_support(cfg)
+            self.traffic = cfg.traffic
+            self.registry = PopulationRegistry(cfg.traffic, self.n,
+                                               self.f, cfg.seed)
+            self._traffic_key = traffic_key(cfg)
+            self._traffic_events = {}
+            if cfg.aggregation not in ("hierarchical", "async"):
+                # Ladder step 2: the bounds-valid fallback kernel,
+                # ledgered as tier-1 like the configured defense.
+                self._traffic_fallback_fn = stage_wrapped(
+                    DEFENSES[cfg.traffic.fallback_defense],
+                    "tier1_aggregate")
+        else:
+            self.traffic = None
+            self.registry = None
+        # Set by the flat _build_round_fns traffic branch only; its
+        # None-ness is the run_span/run_round dispatch sentinel (hier
+        # traffic is in-program slot resampling, no schedule operands).
+        self._traffic_span = None
         self._part_key = jax.random.key(cfg.seed ^ 0x9A47)
         self._krum_select_fn = None  # set for Krum (selection telemetry)
         self.last_round_telemetry = None   # cfg.telemetry, per-round modes
@@ -643,15 +672,18 @@ class FederatedExperiment:
     def _participants(self, t):
         """Round-t cohort ids, or None under full participation: the
         first m_mal entries are malicious ids (< f), the rest honest —
-        random identities, static counts (config.participation)."""
+        random identities, static counts (config.participation).  The
+        draw itself lives in core/population.py:legacy_cohort — the
+        population sampler's uniform-reliability compat profile,
+        relocated verbatim so it stays bit-compatible with every
+        pre-population run (tests/test_traffic.py pins it)."""
         if self.cfg.participation >= 1.0:
             return None
-        k1, k2 = jax.random.split(jax.random.fold_in(self._part_key, t))
-        mal = jax.random.choice(k1, self.f, (self.m_mal,), replace=False)
-        hon = self.f + jax.random.choice(k2, self.n - self.f,
-                                         (self.m - self.m_mal,),
-                                         replace=False)
-        return jnp.concatenate([mal, hon]).astype(jnp.int32)
+        from attacking_federate_learning_tpu.core.population import (
+            legacy_cohort
+        )
+        return legacy_cohort(self._part_key, t, self.n, self.f, self.m,
+                             self.m_mal)
 
     def _participants_host(self, t):
         """Eager host-side cohort for the streaming prefetcher: jax's RNG
@@ -678,10 +710,15 @@ class FederatedExperiment:
             shards, t, self.cfg.batch_size * self.cfg.local_steps)
         return self.train_x[idx], self.train_y[idx]
 
-    def _compute_grads_impl(self, state: ServerState, t, batches=None):
+    def _compute_grads_impl(self, state: ServerState, t, batches=None,
+                            part=None):
         """batches=None gathers from the device-resident dataset; the
         host-streaming mode (cfg.data_placement='host_stream') passes the
-        round's pre-transferred (xs, ys) instead.
+        round's pre-transferred (xs, ys) instead.  ``part`` pre-empts
+        the participation draw with explicit (m,) cohort ids — the
+        traffic engine's host-sampled shard archetypes (a population
+        client materializes as its archetype's data shard + style;
+        core/population.py).
 
         Stage ledger: everything here is the ``deliver`` stage — batch
         delivery + client update, the cohort's gradients arriving at
@@ -689,7 +726,8 @@ class FederatedExperiment:
         cfg = self.cfg
         with stage_scope("deliver"):
             if batches is None:
-                part = self._participants(t)
+                if part is None:
+                    part = self._participants(t)
                 xs, ys = self._gather_batches(t, part)
             else:
                 xs, ys = batches
@@ -723,7 +761,8 @@ class FederatedExperiment:
         return grads
 
     def _aggregate_impl(self, state: ServerState, grads, t, agg=None,
-                        telemetry=False, mask=None, weights=None):
+                        telemetry=False, mask=None, weights=None,
+                        action=None):
         """``agg`` pre-empts the defense call — the Krum-telemetry round
         computes the selection once and aggregates ``grads[sel]`` rather
         than running the O(n^2 d) distance engine twice.  ``telemetry``
@@ -733,7 +772,14 @@ class FederatedExperiment:
         threaded into the mask-aware defense kernels; None (the
         no-fault path) leaves the defense call byte-identical.
         ``weights``: the async staleness weights riding the same seam
-        (core/async_rounds.py; requires ``mask``)."""
+        (core/async_rounds.py; requires ``mask``).
+        ``action``: the traffic watchdog's per-round ladder decision
+        (core/population.py, () int32).  Both the configured defense and
+        the bounds-valid fallback are always computed and jnp.where
+        selects — identical pytree either way, and a NaN in the
+        unselected branch cannot propagate through the select.  HOLD is
+        applied at the state level after the update (FedBuff-style
+        no-op, the async empty-delivery pattern)."""
         ddiag = {}
         if agg is None:
             # Stage ledger: the defense kernel (server_grad included —
@@ -760,6 +806,14 @@ class FederatedExperiment:
                         grads, self.m, self.m_mal, telemetry=True, **kw)
                 else:
                     agg = self.defense_fn(grads, self.m, self.m_mal, **kw)
+                if action is not None:
+                    from attacking_federate_learning_tpu.core.population \
+                        import TRAFFIC_FALLBACK
+                    fb_kw = {k: kw[k] for k in ("mask", "weights")
+                             if k in kw}
+                    fb = self._traffic_fallback_fn(
+                        grads, self.m, self.m_mal, **fb_kw)
+                    agg = jnp.where(action == TRAFFIC_FALLBACK, fb, agg)
         with stage_scope("apply"):
             agg = agg.astype(jnp.float32)
             if self.cfg.server_uses_faded_lr:
@@ -770,6 +824,16 @@ class FederatedExperiment:
                 # (server.py:89, SURVEY.md §2.4 #7).
                 lr = self.cfg.learning_rate
             new_state = momentum_update(state, agg, lr, self.cfg.momentum)
+            if action is not None:
+                from attacking_federate_learning_tpu.core.population \
+                    import TRAFFIC_HOLD
+                hold = action == TRAFFIC_HOLD
+                new_state = ServerState(
+                    weights=jnp.where(hold, state.weights,
+                                      new_state.weights),
+                    velocity=jnp.where(hold, state.velocity,
+                                       new_state.velocity),
+                    round=new_state.round)
         if telemetry:
             return new_state, ddiag
         return new_state
@@ -841,7 +905,8 @@ class FederatedExperiment:
         # quarantine mask, and only the defense call carries it.
         diag_select = (self._krum_select_fn
                        if (cfg.log_round_stats and not cfg.telemetry
-                           and self.faults is None)
+                           and self.faults is None
+                           and self.traffic is None)
                        else None)
 
         def inject_and_quarantine(grads, t, fstate):
@@ -905,8 +970,11 @@ class FederatedExperiment:
             self._secagg_step = secagg_step
 
         if getattr(self.attacker, "fusable", True):
-            def fused_core(state, t, batches=None, fstate=None):
-                grads = self._compute_grads_impl(state, t, batches)
+            def fused_core(state, t, batches=None, fstate=None,
+                           traffic=None):
+                part = traffic[0] if traffic is not None else None
+                grads = self._compute_grads_impl(state, t, batches,
+                                                 part=part)
                 tele = (attack_envelope(grads, state, t) if cfg.telemetry
                         else {})
                 with stage_scope("deliver"):
@@ -920,18 +988,32 @@ class FederatedExperiment:
                 # hide a shadow-train nan); the defense aggregates the
                 # quarantined ``agg_grads``.
                 mask, agg_grads = None, grads
+                if traffic is not None:
+                    # Arrival quarantine: rows whose population client
+                    # never arrived this round are zeroed and masked
+                    # out of the defense (the same mask-aware seam the
+                    # fault quarantine uses, core/population.py).
+                    arrived = traffic[1]
+                    with stage_scope("quarantine"):
+                        agg_grads = jnp.where(
+                            arrived[:, None], agg_grads,
+                            jnp.zeros_like(agg_grads))
+                    mask = arrived
                 if self.faults is not None:
-                    agg_grads, mask, fstate, fstats = (
-                        inject_and_quarantine(grads, t, fstate))
+                    agg_grads, fmask, fstate, fstats = (
+                        inject_and_quarantine(agg_grads, t, fstate))
+                    mask = fmask if mask is None else (mask & fmask)
                     tele = {**tele, **fstats}
                 if self._secagg is not None:
                     agg_grads, sstats = self._secagg_step(agg_grads,
                                                           mask, t)
                     tele = {**tele, **sstats}
                 aux = {}
+                act = traffic[2] if traffic is not None else None
                 if cfg.telemetry:
                     new_state, ddiag = self._aggregate_impl(
-                        state, agg_grads, t, telemetry=True, mask=mask)
+                        state, agg_grads, t, telemetry=True, mask=mask,
+                        action=act)
                     tele = finish_telemetry(tele, agg_grads, ddiag)
                     if (self._krum_select_fn is not None
                             and "selection_mask" in ddiag):
@@ -946,7 +1028,8 @@ class FederatedExperiment:
                         aux["krum_selected"] = sel
                         agg = grads[sel]
                     new_state = self._aggregate_impl(state, agg_grads, t,
-                                                     agg=agg, mask=mask)
+                                                     agg=agg, mask=mask,
+                                                     action=act)
                 return new_state, grads, aux, tele, fstate
 
             def crafted_nonfinite(grads):
@@ -954,7 +1037,43 @@ class FederatedExperiment:
                     return (~jnp.isfinite(
                         grads[: self.m_mal].astype(jnp.float32))).any()
 
-            if self.faults is None:
+            if self.traffic is not None:
+                def fused(state, t, sid, arrived, action, fstate=None):
+                    """One traffic round: the host-sampled schedule row
+                    (shard ids, arrival mask, ladder action) enters as
+                    plain device operands — the compiled program never
+                    sees the population, only the (m,) cohort."""
+                    new_state, grads, aux, tele, fstate = fused_core(
+                        state, t, None, fstate, (sid, arrived, action))
+                    diag = (round_diagnostics(grads, new_state, t, aux)
+                            if cfg.log_round_stats else {})
+                    bad = (crafted_nonfinite(grads)
+                           if self._check_attack_nan
+                           else jnp.asarray(False))
+                    return new_state, diag, bad, tele, fstate
+
+                def traffic_span(state, t0, count, sids, arrs, acts,
+                                 fstate=None):
+                    # Traffic span: like fault_span (scan, static count)
+                    # but each round consumes its row of the host-
+                    # sampled schedule.  The carry threads only the
+                    # fault state — the traffic schedule itself is
+                    # stateless (pure in (traffic seed, t)), which is
+                    # what makes preempt→resume bit-for-bit free.
+                    def body(carry, xs):
+                        s, bad, fs = carry
+                        i, sid, arr, act = xs
+                        s2, grads, _, tele, fs = fused_core(
+                            s, t0 + i, None, fs, (sid, arr, act))
+                        if self._check_attack_nan:
+                            bad = bad | crafted_nonfinite(grads)
+                        return (s2, bad, fs), tele
+
+                    (s, bad, fs), stacked = jax.lax.scan(
+                        body, (state, jnp.asarray(False), fstate),
+                        (jnp.arange(count), sids, arrs, acts))
+                    return s, bad, fs, stacked
+            elif self.faults is None:
                 def fused(state, t, batches=None):
                     new_state, grads, aux, tele, _ = fused_core(state, t,
                                                                 batches)
@@ -1031,7 +1150,14 @@ class FederatedExperiment:
                 return s, bad, fs, stacked
 
             donate = self._donate_kw()
-            if self.faults is None:
+            if self.traffic is not None:
+                # Traffic paths never donate (the fault-path rationale:
+                # stacked-scan outputs + schedule operands add aliasing
+                # surface the CPU donation distrust already covers).
+                self._fused_round = jax.jit(fused)
+                self._traffic_span = jax.jit(traffic_span,
+                                             static_argnums=2)
+            elif self.faults is None:
                 self._fused_round = jax.jit(fused, **donate)
                 self._fused_span = jax.jit(fused_span, **donate)
                 self._tele_span = jax.jit(tele_span, static_argnums=2,
@@ -1045,6 +1171,13 @@ class FederatedExperiment:
                 self._fault_span = jax.jit(fault_span, static_argnums=2)
             self._staged = False
         else:
+            if self.traffic is not None:
+                # Config already rejects --backdoor-staged + traffic;
+                # this catches a non-fusable attacker handed in
+                # programmatically (same seam as the pallas check below).
+                raise ValueError(
+                    "the traffic engine requires a fusable attack (the "
+                    "staged host-eager path has no arrival seam)")
             if (cfg.aggregation_impl == "pallas"
                     or cfg.bulyan_selection_impl == "pallas"):
                 # Config already rejects --backdoor-staged ⊕ pallas;
@@ -1187,6 +1320,17 @@ class FederatedExperiment:
             kernel's telemetry on THIS shard's sub-matrix, stacked by
             client_map into the (S, ...) shard_selection record) and,
             in the clear modes, the per-row gradient norms."""
+            if self.traffic is not None:
+                # Hier traffic = in-program slot resampling only: each
+                # megabatch slot re-draws its population archetype per
+                # round (pure in (traffic key, t, shard identity) —
+                # core/population.py).  Rounds stay full; the ladder
+                # and churn accounting are flat/async-engine features
+                # (composition matrix, ARCHITECTURE.md).
+                from attacking_federate_learning_tpu.core.population \
+                    import resample_slots
+                ids = resample_slots(self._traffic_key, t, ids, c_mal,
+                                     self.f, self.n)
             with stage_scope("deliver"):
                 shard_rows = self.shards[ids]
                 idx = round_batch_indices(
@@ -1450,6 +1594,18 @@ class FederatedExperiment:
 
         spec = self._async
         D = spec.depth
+        if self.traffic is not None:
+            # Async traffic = latency-profile delivery: per-cohort-slot
+            # heavy-tail Pareto scales (materialized lazily from the
+            # population registry, never a (P,) tensor) replace the
+            # uniform 0..D arrival draw inside the ring
+            # (core/async_rounds.py:draw_delays).
+            from attacking_federate_learning_tpu.core.population import (
+                async_latency_for_cfg
+            )
+            self._traffic_latency = async_latency_for_cfg(cfg, self.m)
+        else:
+            self._traffic_latency = None
 
         def ctx_for(state, t, staleness=None):
             return AttackContext(
@@ -1480,7 +1636,8 @@ class FederatedExperiment:
                     grads, t, self._async_key, spec, astate, self.m_mal,
                     faults=self.faults,
                     fkey=self._fault_key if self.faults is not None
-                    else None)
+                    else None,
+                    latency=self._traffic_latency)
             ctx = ctx_for(state, t, staleness)
             tele = dict(stats)
             if cfg.telemetry:
@@ -1685,6 +1842,29 @@ class FederatedExperiment:
                 entries.append(
                     ("async_span", lambda: self._async_span.lower(
                         self.state, t0, span_len, self._async_state)))
+            elif self.traffic is not None:
+                # Traffic engines expose their two jitted entry points
+                # under their own ledger names; the schedule operands
+                # are abstract (m,)-shaped rows — the lowered program
+                # proves memory scales with the cohort, never the
+                # population (tests/test_traffic.py pins this).
+                sid_sds = jax.ShapeDtypeStruct((self.m,), jnp.int32)
+                arr_sds = jax.ShapeDtypeStruct((self.m,), jnp.bool_)
+                act_sds = jax.ShapeDtypeStruct((), jnp.int32)
+                entries.append(("traffic_round", lambda:
+                                self._fused_round.lower(
+                                    self.state, t0, sid_sds, arr_sds,
+                                    act_sds, self._fault_state)))
+                sids_sds = jax.ShapeDtypeStruct((span_len, self.m),
+                                                jnp.int32)
+                arrs_sds = jax.ShapeDtypeStruct((span_len, self.m),
+                                                jnp.bool_)
+                acts_sds = jax.ShapeDtypeStruct((span_len,), jnp.int32)
+                entries.append(("traffic_span", lambda:
+                                self._traffic_span.lower(
+                                    self.state, t0, span_len, sids_sds,
+                                    arrs_sds, acts_sds,
+                                    self._fault_state)))
             elif self.faults is None:
                 entries.append((round_name, lambda: self._fused_round
                                 .lower(self.state, t0, batches)))
@@ -1906,6 +2086,8 @@ class FederatedExperiment:
         hier = self.cfg.aggregation == "hierarchical"
         if self._async is not None:
             return "async_span"
+        if self.traffic is not None and not hier:
+            return "traffic_span"
         if self.faults is not None:
             return "fault_span"
         if self.cfg.telemetry or self._secagg is not None:
@@ -1928,6 +2110,14 @@ class FederatedExperiment:
             if self._async is not None:
                 low = self._async_span.lower(
                     self.state, t0, int(count), self._async_state)
+            elif self.traffic is not None and name == "traffic_span":
+                c = int(count)
+                low = self._traffic_span.lower(
+                    self.state, t0, c,
+                    jax.ShapeDtypeStruct((c, self.m), jnp.int32),
+                    jax.ShapeDtypeStruct((c, self.m), jnp.bool_),
+                    jax.ShapeDtypeStruct((c,), jnp.int32),
+                    self._fault_state)
             elif self.faults is not None:
                 low = self._fault_span.lower(
                     self.state, t0, int(count), self._fault_state)
@@ -1963,6 +2153,22 @@ class FederatedExperiment:
         if rec is not None and logger is not None:
             logger.record(**rec.wall_event())
         return rec
+
+    def _traffic_plan(self, start: int, count: int):
+        """Host-sampled traffic schedule for rounds [start, start+count):
+        cohort shard ids, arrival masks and ladder actions (one device
+        operand row per round), plus the v11 'traffic' events the run
+        loop emits at the next journal-fresh boundary.  Pure in the
+        traffic seed and the round index (core/population.py), so a
+        resumed run regenerates the identical schedule — no carry
+        state."""
+        from attacking_federate_learning_tpu.core.population import (
+            traffic_schedule
+        )
+        return traffic_schedule(
+            self.registry, start, count, self.m, self.m_mal,
+            self.cfg.defense, self.traffic.fallback_defense,
+            self.traffic.min_cohort)
 
     def run_span(self, start: int, count: int) -> ServerState:
         """Run ``count`` rounds [start, start+count) as one scanned device
@@ -2008,6 +2214,27 @@ class FederatedExperiment:
                                      jnp.asarray(start, jnp.int32),
                                      int(count), self._async_state))
                 self.last_span_telemetry = (int(start), stacked)
+            elif self._traffic_span is not None:
+                # Traffic spans always scan: the host samples the span's
+                # schedule (stateless, pure in (traffic seed, t)) and
+                # each round consumes its row; the watchdog's ladder
+                # decisions land as per-round v11 'traffic' events at
+                # the next host boundary.  Composed faults thread their
+                # state through the same carry.
+                sched = self._traffic_plan(int(start), int(count))
+                self._traffic_events.update(
+                    {e["round"]: e for e in sched.events})
+                (self.state, bad, self._fault_state, stacked) = (
+                    self._traffic_span(
+                        self.state, jnp.asarray(start, jnp.int32),
+                        int(count), jnp.asarray(sched.shard_ids),
+                        jnp.asarray(sched.arrived),
+                        jnp.asarray(sched.action), self._fault_state))
+                # Without telemetry/faults the stacked pytree is empty —
+                # nothing for the emission loop to fetch.
+                self.last_span_telemetry = (
+                    (int(start), stacked)
+                    if jax.tree_util.tree_leaves(stacked) else None)
             elif self.faults is not None:
                 # Fault spans always scan (the stacked per-round pytree
                 # carries the 'fault_*' counts even without telemetry).
@@ -2043,6 +2270,7 @@ class FederatedExperiment:
 
     def run_round(self, t: int) -> ServerState:
         batches = self.stream.get(int(t)) if self._streaming else None
+        t_host = int(t)
         t = jnp.asarray(t, jnp.int32)
         self.last_round_stats = None
         self.last_round_telemetry = None
@@ -2051,6 +2279,15 @@ class FederatedExperiment:
                 (self.state, diag, bad, tele,
                  self._async_state) = self._fused_round(
                     self.state, t, self._async_state, batches)
+            elif self._traffic_span is not None:
+                sched = self._traffic_plan(t_host, 1)
+                self._traffic_events.update(
+                    {e["round"]: e for e in sched.events})
+                (self.state, diag, bad, tele,
+                 self._fault_state) = self._fused_round(
+                    self.state, t, jnp.asarray(sched.shard_ids[0]),
+                    jnp.asarray(sched.arrived[0]),
+                    jnp.asarray(sched.action[0]), self._fault_state)
             elif self.faults is not None:
                 (self.state, diag, bad, tele,
                  self._fault_state) = self._fused_round(
@@ -2408,6 +2645,15 @@ class FederatedExperiment:
                                 logger, t0 + i,
                                 jax.tree.map(lambda a: a[i], host))
                     self.last_span_telemetry = None
+                if self.traffic is not None and self._traffic_events:
+                    # Traffic events are host-born (the schedule knows
+                    # arrivals and ladder actions before the device
+                    # runs) — emitted at the same exactly-once boundary
+                    # as the fetched telemetry.
+                    for tt in range(epoch, boundary + 1):
+                        ev = self._traffic_events.pop(tt, None)
+                        if ev is not None and fresh(tt):
+                            logger.record(kind="traffic", **ev)
                 if journal is not None:
                     journal.commit_rounds(epoch, boundary)
                 epoch = boundary
@@ -2428,6 +2674,10 @@ class FederatedExperiment:
                         logger, epoch,
                         jax.tree.map(np.asarray,
                                      self.last_round_telemetry))
+                if self.traffic is not None and self._traffic_events:
+                    ev = self._traffic_events.pop(epoch, None)
+                    if ev is not None and fresh(epoch):
+                        logger.record(kind="traffic", **ev)
                 if journal is not None:
                     journal.commit_rounds(epoch, epoch)
 

@@ -1,0 +1,472 @@
+"""Client-population registry & deterministic traffic engine.
+
+The reference simulator (and every engine here before this module)
+equates "n clients" with n resident gradient rows drawn every round.
+Production FL samples each round's cohort from a population of millions
+whose availability is bursty, correlated and heavy-tailed — *who shows
+up when* changes outcomes as much as the aggregation rule does.  This
+module gives the engines that population without ever materializing it:
+
+- :class:`PopulationRegistry` — P registered clients (P >> cohort m)
+  whose per-client persistent state (data-shard archetype, femnist-style
+  transform id, reliability profile, churn dwell/phase, latency profile)
+  is materialized LAZILY from counter-based PRNG streams (splitmix64
+  over (seed, salt, pid)).  The registry object holds scalars only — no
+  (P,) array ever exists on host or device; memory scales with the
+  cohort m, never the population P (pinned structurally by
+  tests/test_traffic.py the way perf_gate --memproof pins HBM).
+- A deterministic arrival process: a diurnal-modulated base rate with
+  per-client blockwise on/off churn (each client holds its availability
+  state for ``dwell_i`` consecutive rounds — a stateless alternating-
+  renewal approximation of Markov on/off churn, chosen so availability
+  is a pure function of ``(seed, pid, t)`` and therefore replayable and
+  resume-exact with NO carried traffic state), heavy-tail (discretized
+  Pareto) straggler latencies for the async delivery ring, and a
+  time-correlated colluder-arrival knob (sybil burst window: colluders
+  arrive only inside a periodic window, boosted by period/width so the
+  AVERAGE arrived-colluder mass matches the uniform profile —
+  participation itself becomes an attack axis at fixed average f).
+- The defense-validity watchdog: per-round effective-cohort accounting
+  (arrived rows / arrived-malicious rows through the existing
+  mask-aware kernel seam) and a declared degradation ladder evaluated
+  on host at schedule time — re-mask the configured defense to the
+  arrived sub-cohort while its validity bound holds (Krum m_eff >=
+  2f+3, Bulyan m_eff >= 4f+3, with f the kernel's STATIC assumed
+  corrupted count: the masked kernels trim f rows whatever arrived),
+  else fall back to a bounds-valid defense (trimmed-mean/median), else
+  hold the round as a FedBuff-style no-op.  Every decision is a
+  versioned 'traffic' event (schema v11) and the whole schedule is
+  PRNG-replayable on host (:func:`replay_traffic` — the
+  fault_matrix-style event diff).
+
+Engine composition matrix (ARCHITECTURE.md "Population & traffic"):
+flat gets the full model (sampled cohorts + churn + ladder + sybil
+burst); async keeps its resident ring but draws arrival delay from the
+latency profile instead of the uniform 0..D draw; hierarchical
+resamples each megabatch's client slots from the population per round
+(rounds stay full — placement assigns every slot — so churn/ladder do
+not apply there); host-streaming, secagg and staged attacks are
+rejected loudly.  Traffic-off leaves every compiled program
+byte-identical (PERF_BASELINE untouched).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+# Degradation-ladder actions, in declared order.  The host watchdog
+# plans one action per round; the device program selects on the planned
+# int (never branches on data), so the schedule replays exactly.
+TRAFFIC_REMASK = 0    # configured defense over the arrived sub-cohort
+TRAFFIC_FALLBACK = 1  # bounds-valid fallback defense (trimmed-mean/median)
+TRAFFIC_HOLD = 2      # FedBuff-style no-op round (state holds)
+ACTION_NAMES = ("remask", "fallback", "hold")
+
+# Validity bounds m_eff >= bound(f) for the mask-aware kernels, with f
+# the kernel's STATIC corrupted count (the masked kernels trim/score
+# against f rows whatever actually arrived — core/faults.py's
+# masked == survivor-submatrix contract).  Krum uses the selection-
+# safety bound 2f+3 (strictly stronger than kernels.py's 2f+1 runnable
+# bound); Bulyan its 4f+3; the coordinate trims need 2f+1 rows to
+# leave one; NoDefense averages whatever arrived.
+DEFENSE_MIN_COHORT = {
+    "NoDefense": lambda f: 1,
+    "Krum": lambda f: 2 * f + 3,
+    "TrimmedMean": lambda f: 2 * f + 1,
+    "Median": lambda f: 2 * f + 1,
+    "Bulyan": lambda f: 4 * f + 3,
+}
+
+
+def defense_min_cohort(name: str, f: int) -> int:
+    return DEFENSE_MIN_COHORT[name](int(f))
+
+
+def plan_action(defense: str, fallback: str, m_eff: int, f_kernel: int,
+                min_cohort: int) -> int:
+    """The watchdog's per-round ladder decision (host, schedule time)."""
+    if m_eff >= max(min_cohort, defense_min_cohort(defense, f_kernel)):
+        return TRAFFIC_REMASK
+    if m_eff >= max(min_cohort, defense_min_cohort(fallback, f_kernel)):
+        return TRAFFIC_FALLBACK
+    return TRAFFIC_HOLD
+
+
+def traffic_key(cfg):
+    """The traffic subsystem's own jax key stream (hier slot resampling
+    and async latency draws), derived from — but distinct from — the
+    experiment seed unless TrafficConfig.seed overrides it; mirrors
+    core/faults.py:fault_key."""
+    seed = (cfg.traffic.seed if cfg.traffic.seed is not None
+            else cfg.seed)
+    return jax.random.key(seed ^ 0x7AF1C)
+
+
+def legacy_cohort(part_key, t, n, f, m, m_mal):
+    """The legacy ``--participation`` cohort draw, relocated verbatim
+    from core/engine.py:_participants: the first m_mal entries are
+    malicious ids (< f), the rest honest — random identities, static
+    counts.  This IS the population sampler's uniform-reliability
+    compat profile: traffic-off partial participation routes through
+    here, bit-compatible with every pre-population run
+    (tests/test_traffic.py pins the draw against the inline formula;
+    tests/test_participation.py pins its invariants)."""
+    k1, k2 = jax.random.split(jax.random.fold_in(part_key, t))
+    mal = jax.random.choice(k1, f, (m_mal,), replace=False)
+    hon = f + jax.random.choice(k2, n - f, (m - m_mal,),
+                                replace=False)
+    return jnp.concatenate([mal, hon]).astype(jnp.int32)
+
+
+# --- counter-based PRNG streams (splitmix64, vectorized numpy) --------
+# Per-client state is a pure function of (seed, salt, pid[, block]) —
+# nothing is stored, so the registry stays O(1) however large P grows,
+# and the schedule replays identically across process restarts.
+
+_GAMMA = np.uint64(0x9E3779B97F4A7C15)
+_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_M2 = np.uint64(0x94D049BB133111EB)
+
+_SALT_SHARD = 1
+_SALT_REL = 2
+_SALT_DWELL = 3
+_SALT_PHASE = 4
+_SALT_LAT = 5
+_SALT_ON = 6
+_SALT_DRAW = 7
+
+
+def _mix(x):
+    # uint64 wraparound is the algorithm; numpy flags scalar overflow
+    # (arrays wrap silently) — silence it locally, not globally.
+    with np.errstate(over="ignore"):
+        x = np.asarray(x, np.uint64)
+        x = (x ^ (x >> np.uint64(30))) * _M1
+        x = (x ^ (x >> np.uint64(27))) * _M2
+        return x ^ (x >> np.uint64(31))
+
+
+def _fold(acc, s):
+    with np.errstate(over="ignore"):
+        return _mix(np.asarray(acc, np.uint64)
+                    ^ (np.asarray(s, np.uint64) + _GAMMA))
+
+
+def _u01(h):
+    # Top 53 bits -> [0, 1) double, the usual splitmix-to-uniform map.
+    return (np.asarray(h, np.uint64) >> np.uint64(11)).astype(
+        np.float64) * (1.0 / (1 << 53))
+
+
+@dataclasses.dataclass
+class TrafficSchedule:
+    """One host-planned span of traffic rounds [t0, t0+count): the scan
+    inputs of the engine's ``traffic_span`` (static shapes, malicious-
+    first rows) plus the per-round 'traffic' event payloads — the host
+    ground truth the emitted events are diffed against."""
+
+    t0: int
+    count: int
+    shard_ids: np.ndarray   # (count, m) int32, rows [0, m_mal) malicious
+    arrived: np.ndarray     # (count, m) bool — the effective-cohort mask
+    action: np.ndarray      # (count,) int32 ladder decision
+    events: list            # count dicts (round/arrived/f_eff/action/...)
+
+
+class PopulationRegistry:
+    """Lazy registry of P clients; see the module docstring.
+
+    Colluders are pids [0, F) with F = max(1, round(P*f/n)) (the
+    population mirrors the cohort's malicious fraction); a colluder's
+    data-shard archetype lands in [0, f), an honest client's in [f, n)
+    — so a sampled cohort's malicious-first rows keep the engines'
+    rows-[0, f) attack invariant, and a population client materializes
+    as exactly its archetype's data shard + femnist-style transform
+    (only n archetypes of client DATA ever exist; distinct population
+    clients may share one, which is the point of P >> n).
+    """
+
+    def __init__(self, tcfg, n: int, f: int, seed: int):
+        self.tcfg = tcfg
+        self.n, self.f = int(n), int(f)
+        self.P = int(tcfg.population)
+        self.F = (max(1, int(round(self.P * f / n))) if f > 0 else 0)
+        self.seed = tcfg.seed if tcfg.seed is not None else seed
+        self._base = _mix(np.uint64(np.uint64(self.seed) + _GAMMA))
+
+    # -- per-client persistent state (lazy, vectorized) ---------------
+    def _h(self, salt, pids, extra=None):
+        h = _fold(_fold(self._base, salt), pids)
+        if extra is not None:
+            h = _fold(h, extra)
+        return h
+
+    def client_state(self, pids):
+        """Materialize per-client state for the GIVEN pids only."""
+        pids = np.asarray(pids, np.int64)
+        t = self.tcfg
+        malicious = pids < self.F
+        shard = np.where(
+            malicious,
+            self._h(_SALT_SHARD, pids) % np.uint64(max(self.f, 1)),
+            np.uint64(self.f)
+            + self._h(_SALT_SHARD, pids) % np.uint64(self.n - self.f),
+        ).astype(np.int64)
+        reliability = (t.reliability_lo
+                       + (t.reliability_hi - t.reliability_lo)
+                       * _u01(self._h(_SALT_REL, pids)))
+        dwell = 1 + (self._h(_SALT_DWELL, pids)
+                     % np.uint64(max(t.churn_dwell, 1))).astype(np.int64)
+        phase = (self._h(_SALT_PHASE, pids)
+                 % dwell.astype(np.uint64)).astype(np.int64)
+        # Per-client latency scale: spread around the configured scale
+        # so the Pareto tails differ per client, not just per draw.
+        latency = t.latency_scale * (0.5 + 1.0 * _u01(
+            self._h(_SALT_LAT, pids)))
+        return {"malicious": malicious, "shard": shard,
+                "style_id": shard, "reliability": reliability,
+                "dwell": dwell, "phase": phase, "latency": latency}
+
+    # -- arrival process ----------------------------------------------
+    def arrival_rate(self, t: int) -> float:
+        """Diurnal-modulated base arrival rate at round t."""
+        tc = self.tcfg
+        r = tc.rate * (1.0 + tc.diurnal_amp
+                       * np.sin(2.0 * np.pi * t / tc.diurnal_period))
+        return float(max(r, 0.0))
+
+    def available(self, pids, t: int, state=None):
+        """(len(pids),) bool availability at round t — pure in
+        ``(seed, pid, t)``.  Each client's on/off state is drawn once
+        per ``dwell_i``-round block (correlated churn episodes); the
+        sybil window reshapes the MALICIOUS arrival probability only."""
+        pids = np.asarray(pids, np.int64)
+        st = state if state is not None else self.client_state(pids)
+        tc = self.tcfg
+        block = ((t + st["phase"]) // st["dwell"]).astype(np.int64)
+        u = _u01(self._h(_SALT_ON, pids, extra=block))
+        p_on = np.clip(self.arrival_rate(t) * st["reliability"], 0.0, 1.0)
+        if tc.sybil_burst_period > 0:
+            in_win = (t % tc.sybil_burst_period) < tc.sybil_burst_width
+            gain = tc.sybil_burst_period / tc.sybil_burst_width
+            p_mal = np.clip(p_on * gain, 0.0, 1.0) if in_win else 0.0
+            p_on = np.where(st["malicious"], p_mal, p_on)
+        return u < p_on
+
+    # -- cohort sampling ----------------------------------------------
+    def _fill(self, t: int, k: int, malicious: bool):
+        """Deterministic rejection-sampled fill of k cohort slots from
+        one pool (colluders or honest): hash-drawn candidates, deduped,
+        arrived-first.  When fewer than k candidates arrived, the
+        absent candidates keep the gather shape (static (m,) ids) with
+        ``arrived=False`` — that under-fill is what the watchdog
+        degrades on."""
+        if k == 0:
+            return (np.zeros(0, np.int64), np.zeros(0, bool))
+        lo, hi = (0, self.F) if malicious else (self.F, self.P)
+        pool = hi - lo
+        budget = max(8 * k, 64)
+        salt = np.uint64(_SALT_DRAW + (10 if malicious else 20))
+        if pool <= budget:
+            # Small pool: a full hashed-order permutation, fresh per t.
+            order = self._h(salt, np.arange(lo, hi), extra=t)
+            cand = lo + np.argsort(order, kind="stable")
+        else:
+            j = np.arange(budget, dtype=np.int64)
+            draw = lo + (self._h(salt, j, extra=t)
+                         % np.uint64(pool)).astype(np.int64)
+            _, first = np.unique(draw, return_index=True)
+            cand = draw[np.sort(first)]
+        avail = self.available(cand, t)
+        here = cand[avail][:k]
+        absent = cand[~avail][: k - len(here)]
+        if len(here) + len(absent) < k:
+            # Pathological (tiny pool, everything arrived or vanished):
+            # repeat candidates to keep the static shape.
+            pad = np.resize(cand, k - len(here) - len(absent))
+            absent = np.concatenate([absent, pad])
+        pids = np.concatenate([here, absent])[:k]
+        arrived = np.zeros(k, bool)
+        arrived[: len(here)] = True
+        return pids.astype(np.int64), arrived
+
+    def sample_cohort(self, t: int, m: int, m_mal: int):
+        """Round-t cohort: (shard_ids (m,) int32 malicious-first,
+        arrived (m,) bool, pids (m,) int64)."""
+        mal_p, mal_a = self._fill(t, m_mal, malicious=True)
+        hon_p, hon_a = self._fill(t, m - m_mal, malicious=False)
+        pids = np.concatenate([mal_p, hon_p])
+        arrived = np.concatenate([mal_a, hon_a])
+        shard_ids = self.client_state(pids)["shard"].astype(np.int32)
+        return shard_ids, arrived, pids
+
+
+def traffic_schedule(registry: PopulationRegistry, t0: int, count: int,
+                     m: int, m_mal: int, defense: str, fallback: str,
+                     min_cohort: int) -> TrafficSchedule:
+    """Host-planned schedule for rounds [t0, t0+count): cohorts, arrival
+    masks, ladder actions and the 'traffic' event payloads.  Pure in
+    (registry config, t) — stateless, so a resumed run regenerates its
+    tail bit-for-bit and :func:`replay_traffic` diffs emitted events
+    against an independent regeneration."""
+    sids = np.zeros((count, m), np.int32)
+    arr = np.zeros((count, m), bool)
+    act = np.zeros((count,), np.int32)
+    events = []
+    for i in range(count):
+        t = t0 + i
+        sid, a, _pids = registry.sample_cohort(t, m, m_mal)
+        sids[i], arr[i] = sid, a
+        m_eff = int(a.sum())
+        f_eff = int(a[:m_mal].sum())
+        action = plan_action(defense, fallback, m_eff, m_mal, min_cohort)
+        act[i] = action
+        events.append({
+            "round": int(t),
+            "arrived": m_eff,
+            "f_eff": f_eff,
+            "cohort": int(m),
+            "action": ACTION_NAMES[action],
+            "defense": (defense if action == TRAFFIC_REMASK
+                        else fallback if action == TRAFFIC_FALLBACK
+                        else "none"),
+        })
+    return TrafficSchedule(t0=int(t0), count=int(count), shard_ids=sids,
+                           arrived=arr, action=act, events=events)
+
+
+def replay_traffic(cfg, epochs: int):
+    """Regenerate the full traffic schedule for a finished run from its
+    config alone — the fault_matrix-style host diff: emitted 'traffic'
+    events must equal these rows exactly."""
+    n, f = cfg.users_count, cfg.corrupted_count
+    if cfg.participation < 1.0:
+        m = max(1, int(round(cfg.participation * n)))
+        m_mal = min(int(round(cfg.participation * f)), m)
+    else:
+        m, m_mal = n, f
+    reg = PopulationRegistry(cfg.traffic, n, f, cfg.seed)
+    sched = traffic_schedule(reg, 0, epochs, m, m_mal, cfg.defense,
+                             cfg.traffic.fallback_defense,
+                             cfg.traffic.min_cohort)
+    return sched.events
+
+
+# --- async latency profile (core/async_rounds.py:draw_delays) ---------
+def async_latency_for_cfg(cfg, m: int):
+    """(scales (m,) f32 jnp, tail float) for the async engine's
+    heavy-tail delay draw: cohort row i is population client i for the
+    malicious rows and F + (i - m_mal) for the honest ones (the async
+    ring is resident, so the cohort<->pid map is fixed), each carrying
+    its lazily-derived latency scale."""
+    f = cfg.corrupted_count
+    reg = PopulationRegistry(cfg.traffic, cfg.users_count, f, cfg.seed)
+    m_mal = min(f, m)
+    pids = np.concatenate([np.arange(m_mal),
+                           reg.F + np.arange(m - m_mal)])
+    scales = reg.client_state(pids)["latency"].astype(np.float32)
+    return jnp.asarray(scales), float(cfg.traffic.latency_tail)
+
+
+def traffic_delays(key, t, scales, tail, depth):
+    """Heavy-tail straggler delay per cohort row: a discretized
+    Pareto(tail) draw scaled by the per-client latency profile, clipped
+    to the delivery-ring depth.  Pure in ``(key, t)`` — runs
+    identically traced (inside the fused async round) and eagerly (the
+    replay_schedule host diff)."""
+    kt = jax.random.fold_in(key, t)
+    u = jax.random.uniform(kt, scales.shape, minval=1e-6, maxval=1.0)
+    raw = scales * (jnp.power(u, -1.0 / tail) - 1.0)
+    return jnp.clip(raw, 0, depth - 1).astype(jnp.int32)
+
+
+# --- hierarchical slot resampling ------------------------------------
+def resample_slots(key, t, ids, c_mal, f, n):
+    """Per-round population resampling of one megabatch's client slots
+    (hier engine): malicious slots draw a shard archetype from [0, f),
+    honest slots from [f, n) — the per-megabatch mirror of the
+    rows-[0, c_mal) invariant.  Hier rounds stay FULL (placement
+    assigns every slot), so churn/under-fill and the ladder do not
+    apply; this is cohort-identity resampling only.  Pure in
+    ``(key, t, ids[0])`` (placement id sets are disjoint, so the first
+    id decorrelates megabatches)."""
+    kt = jax.random.fold_in(jax.random.fold_in(key, t), ids[0])
+    k1, k2 = jax.random.split(kt)
+    mal = jax.random.randint(k1, ids.shape, 0, max(f, 1))
+    hon = f + jax.random.randint(k2, ids.shape, 0, n - f)
+    slot_mal = jnp.arange(ids.shape[0]) < c_mal
+    return jnp.where(slot_mal, mal, hon).astype(ids.dtype)
+
+
+def check_traffic_support(cfg):
+    """Fail fast on configs the traffic engine cannot honor (engine
+    init + campaigns/spec.py pre-validation), in the loud-rejection
+    style of core/faults.py:check_fault_support."""
+    from attacking_federate_learning_tpu.core.faults import (
+        MASK_AWARE_DEFENSES
+    )
+
+    t = cfg.traffic
+    if t.population < cfg.users_count:
+        raise ValueError(
+            f"--traffic-population must cover the cohort pool: "
+            f"P={t.population} < users_count={cfg.users_count} (the "
+            f"registry's shard archetypes span all n clients)")
+    if cfg.secagg != "off":
+        raise ValueError(
+            "--traffic-population is incompatible with --secagg: "
+            "pairwise masks are keyed on client identity, and sampled "
+            "population cohorts re-key every row each round (the same "
+            "structural fact that rejects --participation there)")
+    if cfg.data_placement != "device":
+        raise ValueError(
+            "--traffic-population requires data_placement='device': "
+            "the traffic schedule rides the scanned span as per-round "
+            "scan inputs; the streaming mode feeds one round per "
+            "program by design")
+    if cfg.backdoor and not cfg.backdoor_fused:
+        raise ValueError(
+            "--traffic-population needs the fused backdoor path (drop "
+            "--backdoor-staged): cohort sampling, the arrival mask and "
+            "the degradation ladder all live inside the fused round "
+            "program")
+    if cfg.aggregation == "hierarchical":
+        if cfg.mesh_shape is not None and tuple(cfg.mesh_shape)[0] > 1:
+            raise ValueError(
+                "--traffic-population with hierarchical aggregation "
+                "does not compose with the SPMD client_map "
+                "(--mesh-shape clients axis > 1): the per-round slot "
+                "resampling draws keys inside the scanned megabatch "
+                "body, which the shard_map program does not thread yet")
+        return
+    if cfg.aggregation == "async":
+        return
+    # Flat: the arrival mask and the ladder ride the mask-aware seam.
+    if cfg.defense not in MASK_AWARE_DEFENSES:
+        raise ValueError(
+            f"--traffic-population needs a mask-aware defense "
+            f"{MASK_AWARE_DEFENSES}, got {cfg.defense!r} (the arrival "
+            f"mask must reach the kernel; defenses/kernels.py)")
+    if t.fallback_defense not in MASK_AWARE_DEFENSES:
+        raise ValueError(
+            f"--traffic-fallback must be mask-aware "
+            f"{MASK_AWARE_DEFENSES}, got {t.fallback_defense!r}")
+    host_impls = [
+        ("distance_impl", cfg.distance_impl),
+        ("trimmed_mean_impl", cfg.trimmed_mean_impl),
+        ("median_impl", cfg.median_impl),
+        ("bulyan_selection_impl", cfg.bulyan_selection_impl),
+        ("bulyan_trim_impl", cfg.bulyan_trim_impl),
+    ]
+    for name, val in host_impls:
+        if val == "host":
+            raise ValueError(
+                f"--traffic-population is incompatible with "
+                f"{name}='host': the host engines have no mask seam "
+                f"(defenses/host.py)")

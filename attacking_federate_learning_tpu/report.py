@@ -338,6 +338,42 @@ def async_summary(events):
     return out
 
 
+def traffic_summary(events):
+    """Population-traffic rollup from v11 'traffic' events
+    (core/population.py): per-round arrived counts and effective-f,
+    the degradation-ladder action histogram (remask/fallback/hold),
+    which defenses actually aggregated, and the under-fill rounds.
+    Returns None when the run emitted no traffic events (a
+    static-cohort run)."""
+    recs = sorted((e for e in events if e.get("kind") == "traffic"),
+                  key=lambda e: e.get("round", 0))
+    if not recs:
+        return None
+    arrived = [int(e.get("arrived", 0)) for e in recs]
+    f_eff = [int(e.get("f_eff", 0)) for e in recs]
+    actions = {}
+    defenses = {}
+    for e in recs:
+        a = str(e.get("action", "?"))
+        actions[a] = actions.get(a, 0) + 1
+        d = str(e.get("defense", "?"))
+        defenses[d] = defenses.get(d, 0) + 1
+    degraded = [int(e.get("round", -1)) for e in recs
+                if e.get("action") in ("fallback", "hold")]
+    return {
+        "rounds": len(recs),
+        "arrived_per_round": arrived,
+        "arrived_mean": round(sum(arrived) / len(recs), 3),
+        "arrived_min": min(arrived),
+        "f_eff_per_round": f_eff,
+        "f_eff_mean": round(sum(f_eff) / len(recs), 3),
+        "f_eff_max": max(f_eff) if f_eff else 0,
+        "actions": actions,
+        "defenses": defenses,
+        "degraded_rounds": degraded,
+    }
+
+
 def secagg_summary(events):
     """Secure-aggregation protocol rollup from 'secagg' events (schema
     v5, protocols/secagg.py): rounds under the protocol, dropout-

@@ -416,6 +416,57 @@ def cmd_async(reg, args):
     return 0
 
 
+def cmd_traffic(reg, args):
+    """Registry-resolved population-traffic table
+    (report.py:traffic_summary): per-round arrived counts and
+    effective-f, the degradation-ladder action histogram
+    (remask/fallback/hold), which defenses actually aggregated, and
+    the degraded rounds from a run's v11 'traffic' stream.  Exit 1
+    when the run carries no traffic events (a static-cohort run)."""
+    import json as _json
+
+    from attacking_federate_learning_tpu.report import (
+        load_events, traffic_summary
+    )
+
+    e = reg.resolve(args.query, args.filter)
+    events = e.get("events")
+    if not isinstance(events, str) or not os.path.exists(events):
+        print(f"run {e['run_id']} has no readable event log "
+              f"(events={events!r})")
+        return 1
+    tr = traffic_summary(load_events([events], skip_bad=True))
+    if tr is None:
+        print(f"run {e['run_id']}: no 'traffic' events — the traffic "
+              f"table needs a --traffic-population run")
+        return 1
+    if args.json:
+        print(_json.dumps({e["run_id"]: tr}))
+        return 0
+    print(f"== {e['run_id']} ==")
+    print(f"  traffic rounds {tr['rounds']}: arrived "
+          f"{tr['arrived_mean']}/round (min {tr['arrived_min']}), "
+          f"f_eff {tr['f_eff_mean']}/round (max {tr['f_eff_max']})")
+    print("  arrived per round: "
+          + "  ".join(str(a) for a in tr["arrived_per_round"]))
+    print("  f_eff   per round: "
+          + "  ".join(str(f) for f in tr["f_eff_per_round"]))
+    print("  action      rounds")
+    for a in ("remask", "fallback", "hold"):
+        if a in tr["actions"]:
+            print(f"    {a:<9} {tr['actions'][a]:5d}")
+    for a, n in sorted(tr["actions"].items()):
+        if a not in ("remask", "fallback", "hold"):
+            print(f"    {a:<9} {n:5d}")
+    print("  aggregated by: "
+          + ", ".join(f"{d} x{n}"
+                      for d, n in sorted(tr["defenses"].items())))
+    if tr["degraded_rounds"]:
+        print("  degraded rounds: "
+              + " ".join(str(r) for r in tr["degraded_rounds"]))
+    return 0
+
+
 def cmd_campaign(reg, args):
     """List campaigns, or render one campaign's defense x attack table
     from the registry (report.py:campaign_table).  The registry is
@@ -862,6 +913,12 @@ def main(argv=None) -> int:
                              "async_summary)")
     sp.add_argument("query")
     sp.set_defaults(fn=cmd_async)
+    sp = sub.add_parser("traffic",
+                        help="population-traffic table from v11 "
+                             "'traffic' events (--traffic-population "
+                             "runs; report.py traffic_summary)")
+    sp.add_argument("query")
+    sp.set_defaults(fn=cmd_traffic)
     sp = sub.add_parser("campaign",
                         help="list campaigns, or render one campaign's "
                              "defense x attack table from the registry "

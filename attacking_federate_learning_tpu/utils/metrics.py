@@ -65,7 +65,14 @@ no new host callbacks in-jit) or a profiler-trace capture booked
 onto the stage taxonomy (``source='trace'``: per-stage microseconds
 + unattributed residual summing exactly to wall_s, with op-event
 coverage riding along) — the runtime twin of v9's modeled
-``stage_cost``.
+``stage_cost``; v11 adds ``traffic`` — one population-traffic record
+per round under a ``--traffic-population`` run (core/population.py):
+the arrived-count / effective-f accounting of the sampled cohort and
+the defense-validity watchdog's ladder decision
+(action='remask'/'fallback'/'hold', with the cohort pids, f_eff and
+the defense actually applied riding along) — host-born from the
+PRNG-replayable schedule, so ``replay_traffic`` diffs the emitted
+stream against an independent regeneration.
 Readers accept every version; older logs simply never carry the newer
 kinds, and a newer-only kind stamped with an older version is an
 emitter bug, rejected (``KIND_MIN_VERSION``).
@@ -83,8 +90,8 @@ from typing import Optional
 import numpy as np
 
 
-SCHEMA_VERSION = 10
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
+SCHEMA_VERSION = 11
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11)
 
 # kind -> required fields.  Producers: core/engine.py (round, eval, asr,
 # profile, stream, defense, attack, selection_hist via RunLogger).
@@ -198,6 +205,15 @@ EVENT_KINDS = {
     # 'stage_cost', joined by 'name' for measured-vs-modeled ratios
     # ('runs walls').
     "wall": {"name", "source", "wall_s"},
+    # --- v11: the population & traffic engine (core/population.py) ------
+    # one record per traffic round (emitted with or without --telemetry,
+    # like 'fault'): the arrived count of the sampled cohort, the
+    # arrived-malicious count f_eff, and the defense-validity watchdog's
+    # ladder decision ('action': remask/fallback/hold) with the defense
+    # actually applied and the cohort pids riding along — host-born
+    # from the PRNG-replayable schedule (replay_traffic diffs the
+    # emitted stream against an independent regeneration)
+    "traffic": {"round", "arrived", "action"},
 }
 
 # Minimum schema version per kind introduced after v1; an event carrying
@@ -208,7 +224,7 @@ KIND_MIN_VERSION = {"compile": 2, "cost": 2, "heartbeat": 2,
                     "secagg": 5, "shard_selection": 6, "forensics": 6,
                     "async": 7, "campaign": 8,
                     "stage_cost": 9, "wire_bytes": 9,
-                    "wall": 10}
+                    "wall": 10, "traffic": 11}
 
 # Back-compat alias (pre-v3 spelling used by external readers).
 V2_KINDS = {k for k, v in KIND_MIN_VERSION.items() if v == 2}

@@ -328,7 +328,16 @@ def test_schema_roundtrip_every_engine_kind(tmp_path):
                      async_max_staleness=2, staleness_weight="poly",
                      telemetry=True)
     _, ev6 = _run(cfg6, tmp_path, "roundtrip6")
-    for rec in ev1 + ev2 + ev3 + ev4 + ev5 + ev6:
+    # Run 7: population traffic — the v11 'traffic' kind from a real
+    # engine run (core/population.py schedule, one event per round).
+    from attacking_federate_learning_tpu.config import TrafficConfig
+
+    cfg7 = _tele_cfg(tmp_path, users_count=12, mal_prop=0.25,
+                     defense="Krum", epochs=3, test_step=3,
+                     traffic=TrafficConfig(population=48, rate=0.8,
+                                           seed=3))
+    _, ev7 = _run(cfg7, tmp_path, "roundtrip7")
+    for rec in ev1 + ev2 + ev3 + ev4 + ev5 + ev6 + ev7:
         validate_event(rec)
         assert rec["v"] == SCHEMA_VERSION
         seen.add(rec["kind"])

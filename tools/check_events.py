@@ -32,7 +32,10 @@ telemetry from --profile-every runs — source='host' per-span/per-eval
 host-clock walls from core/engine.py's fetch boundary, and
 source='trace' per-stage booked walls from a jax.profiler capture,
 utils/walls.py, whose stages + unattributed_us partition the booked
-total exactly).  An
+total exactly — plus v11's 'traffic' kind: one population-traffic
+record per round under --traffic-population runs, core/population.py
+— arrived/f_eff cohort accounting and the defense-validity watchdog's
+ladder action, replayable on host via replay_traffic).  An
 event stamped with a
 version this reader does not know is reported as "produced by a newer
 writer" — a clear per-line error, never a KeyError — and a newer-only

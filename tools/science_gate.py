@@ -121,6 +121,22 @@ CELLS = {
                               aggregation="async", async_buffer=12,
                               async_max_staleness=2,
                               staleness_weight="poly"),
+    # --- PR 17: population traffic (ISSUE 17, core/population.py).
+    # The behavioral constants under sampled-cohort churn: each
+    # round's 19 rows are drawn from a deliberately tight 24-client
+    # registry at rate 0.5 (dwell-3 churn episodes), so the cohort
+    # under-fills Krum's 2f+3 validity bound on some rounds and walks
+    # the whole degradation ladder (7 remask / 2 TrimmedMean fallback
+    # / 1 hold at these constants).  The schedule facts (arrived_mean,
+    # degraded_rounds) replay exactly — the schedule is pure in
+    # (TrafficConfig, seed, t) — band 0; the accuracy is
+    # Krum-selection-mediated over a changing cohort, banded like the
+    # other krum cells.
+    "traffic_krum_churn": dict(defense="Krum", z=1.5,
+                               traffic=dict(population=24, rate=0.5,
+                                            churn_dwell=3,
+                                            fallback_defense="TrimmedMean",
+                                            seed=17)),
 }
 
 # Per-metric tolerance bands (absolute; 0 = exact).  Authored here,
@@ -169,6 +185,10 @@ CELL_BANDS = {
     # async Krum cell is selection-mediated (delivered-cohort Krum
     # picks rest on the same f32 near-ties as the sync cells).
     "async_krum_alie15": {"final_accuracy": 3.0, "max_accuracy": 3.0},
+    # Churned-cohort Krum: accuracy is selection-mediated (same ulp-tie
+    # mechanism, now over per-round sampled rows); the schedule facts
+    # are exact host replays (band 0 via the metric defaults).
+    "traffic_krum_churn": {"final_accuracy": 3.0, "max_accuracy": 3.0},
 }
 
 
@@ -222,7 +242,9 @@ def measure_cell(name: str, spec: dict, rounds: int = ROUNDS) -> dict:
         mal_placement=spec.get("mal_placement", "spread"),
         async_buffer=spec.get("async_buffer", 0),
         async_max_staleness=spec.get("async_max_staleness", 2),
-        staleness_weight=spec.get("staleness_weight", "none"))
+        staleness_weight=spec.get("staleness_weight", "none"),
+        traffic=(C.TrafficConfig(**spec["traffic"])
+                 if "traffic" in spec else None))
     ds = load_dataset(cfg.dataset, seed=0, synth_train=cfg.synth_train,
                       synth_test=cfg.synth_test)
     if backdoor:
@@ -262,6 +284,18 @@ def measure_cell(name: str, spec: dict, rounds: int = ROUNDS) -> dict:
             accs.append(100.0 * float(correct) / len(ds.test_y))
     out = {"final_accuracy": round(accs[-1], 4),
            "max_accuracy": round(max(accs), 4)}
+    if cfg.traffic is not None and cfg.traffic.enabled:
+        # Schedule facts from the host replay (pure in config + t):
+        # average arrived cohort and ladder-degraded round count.
+        from attacking_federate_learning_tpu.core.population import (
+            replay_traffic
+        )
+
+        tev = replay_traffic(cfg, rounds)
+        out["arrived_mean"] = round(
+            sum(e["arrived"] for e in tev) / len(tev), 4)
+        out["degraded_rounds"] = sum(
+            1 for e in tev if e["action"] != "remask")
     if shard_events:
         from attacking_federate_learning_tpu.report import (
             forensics_summary

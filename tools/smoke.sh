@@ -5,9 +5,10 @@
 # science gate + registry selfcheck + hierarchical-aggregation smoke +
 # secure-aggregation smoke + hierarchical-telemetry/forensics smoke +
 # asynchronous-rounds smoke + campaign-engine kill/resume smoke +
-# measured-walls smoke (profiled run, runs walls, wall gate).
+# measured-walls smoke (profiled run, runs walls, wall gate) +
+# population-traffic smoke (churn run, ladder audit, runs traffic).
 #
-#   bash tools/smoke.sh            # all twelve, CPU-pinned
+#   bash tools/smoke.sh            # all thirteen, CPU-pinned
 #   bash tools/smoke.sh --fast     # skip the fault + crash matrices
 #                                  # (the two slowest legs)
 #
@@ -68,7 +69,14 @@
 #      over its private log, 'runs walls' exit-0 on the run, and the
 #      noise-banded wall gate's self-consistency: a fresh --update
 #      baseline in a temp dir must gate clean at k=3
-#      (tools/wall_gate.py).
+#      (tools/wall_gate.py);
+#  13. population-traffic smoke — a journaled 10-round churn run from a
+#      deliberately unreliable 16-client population (the cohort
+#      routinely under-fills the Krum validity bound, forcing the
+#      degradation ladder), check_events over its private log (schema
+#      v11 'traffic' events), a replay audit (emitted events must
+#      equal core/population.py:replay_traffic exactly, with at least
+#      one degraded round), and 'runs traffic <id>' exit-0.
 #
 # Exit: nonzero if any leg fails.  Always CPU (the gates' baselines are
 # CPU artifacts, and the matrices must not touch a TPU capture).
@@ -83,33 +91,33 @@ fail=0
 shopt -s nullglob
 jsonls=(logs/*.jsonl)
 if [ ${#jsonls[@]} -gt 0 ]; then
-    echo "== smoke 1/12: check_events (${#jsonls[@]} logs) =="
+    echo "== smoke 1/13: check_events (${#jsonls[@]} logs) =="
     python tools/check_events.py "${jsonls[@]}" || fail=1
 else
-    echo "== smoke 1/12: check_events — no logs/*.jsonl yet, skipped =="
+    echo "== smoke 1/13: check_events — no logs/*.jsonl yet, skipped =="
 fi
 
 crash_work=""
 if [ "${1:-}" != "--fast" ]; then
-    echo "== smoke 2/12: fault_matrix =="
+    echo "== smoke 2/13: fault_matrix =="
     python tools/fault_matrix.py || fail=1
-    echo "== smoke 3/12: crash_matrix (supervised preempt/resume) =="
+    echo "== smoke 3/13: crash_matrix (supervised preempt/resume) =="
     # Keep the matrix's run stores: leg 6 registry-checks them.
     crash_work="$(mktemp -d -t crash_matrix_XXXXXX)"
     python tools/crash_matrix.py --workdir "$crash_work" || fail=1
 else
-    echo "== smoke 2/12: fault_matrix — skipped (--fast) =="
-    echo "== smoke 3/12: crash_matrix — skipped (--fast) =="
+    echo "== smoke 2/13: fault_matrix — skipped (--fast) =="
+    echo "== smoke 3/13: crash_matrix — skipped (--fast) =="
 fi
 
-echo "== smoke 4/12: perf_gate (+ memproof + wireproof + pallasproof"
+echo "== smoke 4/13: perf_gate (+ memproof + wireproof + pallasproof"
 echo "   + shardproof + stageproof) =="
 python tools/perf_gate.py --memproof || fail=1
 
-echo "== smoke 5/12: science_gate (behavioral drift) =="
+echo "== smoke 5/13: science_gate (behavioral drift) =="
 python tools/science_gate.py || fail=1
 
-echo "== smoke 6/12: runs selfcheck (registry) =="
+echo "== smoke 6/13: runs selfcheck (registry) =="
 python -m attacking_federate_learning_tpu.cli runs selfcheck || fail=1
 if [ -n "$crash_work" ]; then
     # The registry over the crash matrix's preempt/resume artifacts:
@@ -126,7 +134,7 @@ if [ -n "$crash_work" ]; then
     rm -rf "$crash_work"
 fi
 
-echo "== smoke 7/12: hierarchical aggregation (journaled, audited) =="
+echo "== smoke 7/13: hierarchical aggregation (journaled, audited) =="
 hier_work="$(mktemp -d -t hier_smoke_XXXXXX)"
 for def in Krum TrimmedMean; do
     python -m attacking_federate_learning_tpu.cli \
@@ -152,7 +160,7 @@ sys.exit(bad)
 PY
 rm -rf "$hier_work"
 
-echo "== smoke 8/12: secure aggregation (journaled, audited) =="
+echo "== smoke 8/13: secure aggregation (journaled, audited) =="
 sa_work="$(mktemp -d -t secagg_smoke_XXXXXX)"
 # vanilla: one dropout-rate high enough that the 5-round seeded run is
 # guaranteed (and pinned by the audit below) to include at least one
@@ -201,7 +209,7 @@ sys.exit(bad)
 PY
 rm -rf "$sa_work"
 
-echo "== smoke 9/12: hierarchical telemetry + forensics (journaled) =="
+echo "== smoke 9/13: hierarchical telemetry + forensics (journaled) =="
 fx_work="$(mktemp -d -t hier_tele_smoke_XXXXXX)"
 # 5-round journaled hierarchical x Krum run with --telemetry: the run
 # must emit one schema-v6 'shard_selection' event per round.
@@ -238,7 +246,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     trace hier_tele_smoke -o "$fx_work/trace.json" || fail=1
 rm -rf "$fx_work"
 
-echo "== smoke 10/12: asynchronous rounds (journaled, audited) =="
+echo "== smoke 10/13: asynchronous rounds (journaled, audited) =="
 as_work="$(mktemp -d -t async_smoke_XXXXXX)"
 # 5-round journaled FedBuff runs: k=8 of n=12 aggregated per applied
 # round, staleness bound 2, poly weighting, Krum + TrimmedMean.
@@ -288,7 +296,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     async async_Krum_smoke || fail=1
 rm -rf "$as_work"
 
-echo "== smoke 11/12: campaign engine (kill + resume, audited) =="
+echo "== smoke 11/13: campaign engine (kill + resume, audited) =="
 ce_work="$(mktemp -d -t campaign_smoke_XXXXXX)"
 cat > "$ce_work/spec.json" <<SPEC
 {"name": "smoke",
@@ -340,7 +348,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     campaign "$camp_id" || fail=1
 rm -rf "$ce_work"
 
-echo "== smoke 12/12: measured walls (profiled run + wall gate) =="
+echo "== smoke 12/13: measured walls (profiled run + wall gate) =="
 wl_work="$(mktemp -d -t walls_smoke_XXXXXX)"
 # 5-round journaled flat x Krum with every eval interval profiled: the
 # engine books each span capture onto the stage taxonomy and emits
@@ -385,6 +393,66 @@ python tools/wall_gate.py --update --baseline "$wl_work/WALL_BASELINE.json" \
     > /dev/null || fail=1
 python tools/wall_gate.py --baseline "$wl_work/WALL_BASELINE.json" || fail=1
 rm -rf "$wl_work"
+
+echo "== smoke 13/13: population traffic (churn, ladder, audited) =="
+tr_work="$(mktemp -d -t traffic_smoke_XXXXXX)"
+# 10-round journaled churn run from an unreliable 16-client population:
+# the sampled cohort routinely misses Krum's 2f+3 validity bound, so
+# the run only completes by walking the declared degradation ladder
+# (remask -> TrimmedMean fallback -> hold), every decision a v11
+# 'traffic' event.
+python -m attacking_federate_learning_tpu.cli \
+    -d Krum -s SYNTH_MNIST -n 12 -m 0.25 -c 16 -e 10 \
+    --synth-train 256 --synth-test 64 --seed 1 \
+    --traffic-population 16 --traffic-rate 0.6 --traffic-churn-dwell 2 \
+    --traffic-fallback TrimmedMean --traffic-seed 5 \
+    --journal --run-id traffic_smoke --no-checkpoint \
+    --log-dir "$tr_work/logs" --run-dir "$tr_work/runs" \
+    > /dev/null || fail=1
+# The private log must validate (v11 'traffic' events included).
+python tools/check_events.py "$tr_work/logs/traffic_smoke.jsonl" || fail=1
+# Journal audit (exactly-once) + the replay audit: the emitted traffic
+# events must equal the independent host regeneration of the schedule,
+# and the under-fill must actually have forced a degradation step.
+python - "$tr_work" <<'PY' || fail=1
+import json, os, sys
+from attacking_federate_learning_tpu import config as C
+from attacking_federate_learning_tpu.core.population import replay_traffic
+from attacking_federate_learning_tpu.utils.lifecycle import RunJournal
+work = sys.argv[1]
+problems = RunJournal(os.path.join(work, "runs"), "traffic_smoke").verify(
+    epochs=10, test_step=5)
+events = [json.loads(line) for line in
+          open(os.path.join(work, "logs", "traffic_smoke.jsonl"))]
+tr = sorted((e for e in events if e.get("kind") == "traffic"),
+            key=lambda e: e["round"])
+cfg = C.ExperimentConfig(
+    dataset=C.SYNTH_MNIST, users_count=12, mal_prop=0.25, batch_size=16,
+    epochs=10, synth_train=256, synth_test=64, seed=1, defense="Krum",
+    traffic=C.TrafficConfig(population=16, rate=0.6, churn_dwell=2,
+                            fallback_defense="TrimmedMean", seed=5))
+want = replay_traffic(cfg, 10)
+keys = ("round", "arrived", "f_eff", "cohort", "action", "defense")
+if len(tr) != 10:
+    problems.append(f"{len(tr)} traffic events, want one per round")
+if any(e.get("v", 0) < 11 for e in tr):
+    problems.append("traffic event stamped below v11")
+if ([tuple(e[k] for k in keys) for e in tr]
+        != [tuple(e[k] for k in keys) for e in want]):
+    problems.append("emitted traffic events diverge from the host replay")
+if not any(e["action"] in ("fallback", "hold") for e in tr):
+    problems.append("under-fill never forced a degradation step")
+degraded = sum(1 for e in tr if e["action"] != "remask")
+status = "ok" if not problems else f"FAIL {problems}"
+print(f"  traffic traffic_smoke: {len(tr)} events, "
+      f"{degraded} degraded rounds ({status})")
+sys.exit(bool(problems))
+PY
+# Registry-resolved traffic table must render (runs traffic verb).
+python -m attacking_federate_learning_tpu.cli runs \
+    --run-dir "$tr_work/runs" --bench '' --progress '' \
+    traffic traffic_smoke || fail=1
+rm -rf "$tr_work"
 
 if [ $fail -ne 0 ]; then
     echo "SMOKE FAILED"

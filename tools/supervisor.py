@@ -98,6 +98,12 @@ class Supervisor:
         self.opts = opts
         self.raw = opts.raw
         self.child_args = list(child_args)
+        # Backoff jitter stream: seeded per PROCESS (pid + clock), so k
+        # children supervising identical configs draw different sleeps
+        # (see backoff()); tests inject a seeded Random here.
+        import random
+        self.rng = random.Random((os.getpid() << 20)
+                                 ^ time.time_ns())
         self.failures = 0          # counted against --max-retries
         self.preempts = 0
         self.class_counts = {}
@@ -295,11 +301,25 @@ class Supervisor:
 
     # --- main loop --------------------------------------------------------
     def backoff(self, cls):
+        """Bounded exponential backoff with decorrelation jitter.
+
+        k identical campaign children that crash on the same cause
+        (a dead relay, a full disk) all compute the same exponential
+        envelope — without jitter they wake in lockstep and re-collide
+        every cycle.  The sleep is drawn uniformly from the upper half
+        of the envelope, ``[env/2, env]`` with
+        ``env = min(backoff_max, backoff_base * 2**(failures-1))``:
+        still exponentially growing and still capped, but any two
+        children decorrelate by up to half a cycle.  The draw comes
+        from ``self.rng`` — a PROCESS-seeded stream (never the
+        experiment seed: children sharing a config must not share
+        sleeps), injectable for tests."""
         if cls == "preempted":
             return 0.0
         n = max(0, self.failures - 1)
-        return min(self.opts.backoff_max,
-                   self.opts.backoff_base * (2 ** n))
+        env = min(self.opts.backoff_max,
+                  self.opts.backoff_base * (2 ** n))
+        return env / 2.0 + self.rng.random() * (env / 2.0)
 
     def verify_journal(self):
         if self.raw or not self.opts.verify_journal:
