@@ -74,3 +74,24 @@ class DriftAttack(Attack):
                 "sigma_norm": sigma_norm,
                 "drift_norm": jnp.asarray(self.num_std,
                                           jnp.float32) * sigma_norm}
+
+    def margin_stats(self, users_grads, corrupted_count, ctx=None,
+                     crafted=None):
+        """Envelope utilization (cfg.margins, ISSUE 18): the z the
+        attack spends vs. the paper's z_max for this cohort —
+        ``z_utilization`` < 1 means hiding room left on the table, > 1
+        means the drift has left the regime the paper's majority
+        argument covers (inf when z_max is 0: no hiding room exists at
+        this n/f) — plus the drift magnitude in envelope units."""
+        f = corrupted_count
+        if f == 0 or self.num_std == 0:
+            return {}
+        z = float(self.num_std)
+        z_max = paper_z(users_grads.shape[0], f)
+        util = z / z_max if z_max > 0 else float("inf")
+        _, stdev = delivered_cohort_stats(users_grads[:f], ctx)
+        return {"z_used": jnp.asarray(z, jnp.float32),
+                "z_max": jnp.asarray(z_max, jnp.float32),
+                "z_utilization": jnp.asarray(util, jnp.float32),
+                "drift_norm": jnp.asarray(z, jnp.float32)
+                * jnp.linalg.norm(stdev)}

@@ -221,6 +221,36 @@ class BackdoorAttack(Attack):
                 "shadow_loss": loss,
                 "poison_acc": 100.0 * correct / self.poison_count}
 
+    def margin_stats(self, users_grads, corrupted_count, ctx=None,
+                     crafted=None):
+        """Boost headroom (cfg.margins, ISSUE 18): how hard the crafted
+        rows press against the ALIE clip envelope they were laundered
+        through.  ``clip_saturation`` — the fraction of malicious
+        coordinates pinned at a clip boundary (1.0 means the shadow
+        objective wanted more than the envelope allows everywhere);
+        ``boost_headroom`` — the mean remaining distance to the nearer
+        clip edge, normalized by the envelope halfwidth (0 = at the
+        boundary, 1 = at the honest mean).  Measured on the POST-attack
+        rows against the PRE-attack envelope — no shadow-train
+        re-run."""
+        f = corrupted_count
+        if f == 0 or self.num_std == 0 or crafted is None:
+            return {}
+        if ctx is not None and ctx.staleness is not None:
+            mean, stdev = masked_cohort_stats(users_grads[:f],
+                                              ctx.staleness[:f] >= 0)
+        else:
+            mean, stdev = cohort_stats(users_grads[:f])
+        half = jnp.asarray(self.num_std, jnp.float32) * stdev
+        lo, hi = mean - half, mean + half
+        rows = crafted[:f]
+        sat = jnp.mean(((rows <= lo[None, :]) | (rows >= hi[None, :]))
+                       .astype(jnp.float32))
+        head = jnp.minimum(hi[None, :] - rows, rows - lo[None, :])
+        return {"clip_saturation": sat,
+                "boost_headroom": jnp.mean(
+                    head / jnp.maximum(half[None, :], 1e-12))}
+
     def test_asr(self, flat_w, logger=None, tag="POST"):
         """Attack success rate of the *server* weights on the poisoned set
         (reference main.py:91-95 + backdoor.py:67-102); log line format

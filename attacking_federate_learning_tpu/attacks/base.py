@@ -111,6 +111,22 @@ class Attack:
         Default: nothing to report."""
         return {}
 
+    def margin_stats(self, users_grads, corrupted_count: int,
+                     ctx: Optional[AttackContext] = None,
+                     crafted=None) -> dict:
+        """Margin-observatory seam (core/engine.py, cfg.margins; ISSUE
+        18): fixed-shape, device-side ENVELOPE-UTILIZATION margins —
+        how much of the defense-evading envelope the attack actually
+        spends (the attack-side complement of the defenses' decision
+        margins, utils/margins.py).  ``users_grads`` is the PRE-attack
+        matrix (the honest view ``craft`` derives its statistics
+        from); ``crafted`` is the POST-attack matrix, for attacks
+        whose utilization is a property of the delivered rows (the
+        backdoor's clip saturation).  Must stay pure jax (it runs
+        inside the fused round program; no host callbacks).  Default:
+        nothing to report."""
+        return {}
+
 
 class NoAttack(Attack):
     name = "none"

@@ -427,6 +427,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "(and --secagg groupwise) the same flag emits "
                         "per-shard tier-1 + tier-2 'shard_selection' "
                         "events — read with 'report forensics'")
+    p.add_argument("--margins", action="store_true",
+                   help="robustness-margin observatory (utils/margins.py): "
+                        "the defense's in-jit decision margins (Krum "
+                        "winner/runner-up gap + per-row distance to the "
+                        "selection threshold, trim boundary distances + "
+                        "kept fractions, Bulyan selection slack) and the "
+                        "attack's envelope utilization, rolled up into "
+                        "one schema-v12 'margin' event per round — the "
+                        "colluder-survival ledger (read with 'runs "
+                        "margins').  Requires a margin-bearing defense "
+                        "(Krum/TrimmedMean/Median/Bulyan) on an "
+                        "on-device impl")
     p.add_argument("--trace-dir", type=str, default=None,
                    help="capture a jax.profiler XLA trace into this dir")
     p.add_argument("--profile-every", default=0, type=int, metavar="K",
@@ -530,6 +542,7 @@ def config_from_args(args) -> ExperimentConfig:
         server_uses_faded_lr=args.server_uses_faded_lr,
         log_round_stats=args.round_stats,
         telemetry=args.telemetry,
+        margins=args.margins,
         synth_train=args.synth_train,
         synth_test=args.synth_test,
         data_augment={"auto": None, "on": True, "off": False}[args.augment],

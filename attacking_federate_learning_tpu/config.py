@@ -554,6 +554,22 @@ class ExperimentConfig:
     # server-visible there.  Off by default: the compiled round
     # program is bit-identical to the pre-telemetry one.
     telemetry: bool = False
+    # Robustness-margin observatory (utils/margins.py; ISSUE 18): the
+    # defenses additionally return their DECISION MARGINS — Krum's
+    # winner/runner-up gap and every row's signed distance to the
+    # selection threshold, the trim kernels' per-coordinate boundary
+    # distance and kept-coordinate fractions, Bulyan's per-iteration
+    # selection slack — as fixed-shape fields riding the same telemetry
+    # diagnostics pytree (no host callbacks in-jit), and attacks their
+    # envelope utilization (attacks/base.py margin_stats).  The engine
+    # rolls them up host-side into one 'margin' event per round (schema
+    # v12): the colluder-survival ledger ('runs margins' renders the
+    # trajectories).  Requires a margin-bearing defense (Krum /
+    # TrimmedMean / Median / Bulyan) on the on-device score path —
+    # host-marshalled impls never materialize the scores the margins
+    # are read from.  Off by default: the compiled round program is
+    # bit-identical to the margins-less one (PERF_BASELINE pins this).
+    margins: bool = False
 
     def __post_init__(self):
         if self.model is not None and self.model in MODEL_FAMILY:
@@ -820,6 +836,30 @@ class ExperimentConfig:
         if self.local_steps < 1:
             raise ValueError(
                 f"local_steps must be >= 1, got {self.local_steps}")
+        if self.margins:
+            # Margins are read from the ON-DEVICE score/rank tensors the
+            # robust kernels already build; every config that never
+            # materializes them is rejected here, loudly, with the
+            # offending knob named (tests/test_margins.py pins the
+            # message contract).
+            _MARGIN_DEFENSES = ("Krum", "TrimmedMean", "Median", "Bulyan")
+            if self.defense not in _MARGIN_DEFENSES:
+                raise ValueError(
+                    f"--margins measures a robust defense's decision "
+                    f"margins; defense {self.defense!r} makes no "
+                    f"selection/trim decision to measure (use one of "
+                    f"{'/'.join(_MARGIN_DEFENSES)})")
+            for knob in ("trimmed_mean_impl", "median_impl",
+                         "bulyan_trim_impl", "distance_impl",
+                         "bulyan_selection_impl"):
+                if getattr(self, knob) == "host":
+                    raise ValueError(
+                        f"--margins reads the on-device score/rank "
+                        f"tensors inside the fused round program; "
+                        f"{knob}='host' marshals that stage to a native "
+                        f"kernel that returns only its aggregate, never "
+                        f"the per-row margins (set {knob} to an "
+                        f"on-device impl)")
         if not (0.0 < self.participation <= 1.0):
             raise ValueError(
                 f"participation must be in (0, 1], got "

@@ -761,8 +761,8 @@ class FederatedExperiment:
         return grads
 
     def _aggregate_impl(self, state: ServerState, grads, t, agg=None,
-                        telemetry=False, mask=None, weights=None,
-                        action=None):
+                        telemetry=False, margins=False, mask=None,
+                        weights=None, action=None):
         """``agg`` pre-empts the defense call — the Krum-telemetry round
         computes the selection once and aggregates ``grads[sel]`` rather
         than running the O(n^2 d) distance engine twice.  ``telemetry``
@@ -802,6 +802,12 @@ class FederatedExperiment:
                         state.weights, self._meta_x, self._meta_y)
                     kw["server_grad"] = server_grad
                 if telemetry:
+                    if margins:
+                        # Trace-time flag like telemetry itself; only
+                        # the margin-bearing kernels accept it (config
+                        # gates --margins to exactly those), so the
+                        # kwarg is only ever passed when True.
+                        kw["margins"] = True
                     agg, ddiag = self.defense_fn(
                         grads, self.m, self.m_mal, telemetry=True, **kw)
                 else:
@@ -905,6 +911,7 @@ class FederatedExperiment:
         # quarantine mask, and only the defense call carries it.
         diag_select = (self._krum_select_fn
                        if (cfg.log_round_stats and not cfg.telemetry
+                           and not cfg.margins
                            and self.faults is None
                            and self.traffic is None)
                        else None)
@@ -937,17 +944,33 @@ class FederatedExperiment:
                                                      ctx_for(state, t))
             return {"attack_" + k: v for k, v in stats.items()}
 
+        def attack_margins(pre, post, state, t):
+            """Attack-side envelope utilization (attacks/base.py
+            margin_stats; cfg.margins): computed on the PRE-attack
+            matrix with the POST-attack (crafted) matrix riding along,
+            keyed ``margin_attack_*`` so the emitter routes it into the
+            'margin' event.  Stage ledger: ``deliver``."""
+            with stage_scope("deliver"):
+                stats = self.attacker.margin_stats(
+                    pre, self.m_mal, ctx_for(state, t), crafted=post)
+            return {"margin_attack_" + k: v for k, v in stats.items()}
+
         def finish_telemetry(tele, grads, ddiag):
             """Merge defense diagnostics + population stats into the
             round's telemetry pytree (all fixed-shape device arrays).
+            Under margins-without-telemetry only the ``margin_*``
+            defense fields ride out (``ddiag`` itself is untouched —
+            the krum_selected aux still reads its selection mask).
             Stage ledger: defense forensics — ``tier1_aggregate``."""
             from attacking_federate_learning_tpu.defenses.kernels import (
                 population_telemetry
             )
             with stage_scope("tier1_aggregate"):
                 for k, v in ddiag.items():
-                    tele["defense_" + k] = v
-                tele.update(population_telemetry(grads))
+                    if cfg.telemetry or k.startswith("margin_"):
+                        tele["defense_" + k] = v
+                if cfg.telemetry:
+                    tele.update(population_telemetry(grads))
             return tele
 
         if self._secagg is not None:
@@ -977,11 +1000,15 @@ class FederatedExperiment:
                                                  part=part)
                 tele = (attack_envelope(grads, state, t) if cfg.telemetry
                         else {})
+                pre_attack = grads if cfg.margins else None
                 with stage_scope("deliver"):
                     # Attack craft happens on the wire: what tier 1
                     # receives IS the crafted matrix.
                     grads = self.attacker.apply(grads, self.m_mal,
                                                 ctx_for(state, t))
+                if cfg.margins:
+                    tele = {**tele,
+                            **attack_margins(pre_attack, grads, state, t)}
                 # ``grads`` stays the post-attack, PRE-fault matrix from
                 # here on (the nan guard must see what the attacker
                 # crafted — a dropout zeroing a malicious row must not
@@ -1010,10 +1037,10 @@ class FederatedExperiment:
                     tele = {**tele, **sstats}
                 aux = {}
                 act = traffic[2] if traffic is not None else None
-                if cfg.telemetry:
+                if cfg.telemetry or cfg.margins:
                     new_state, ddiag = self._aggregate_impl(
-                        state, agg_grads, t, telemetry=True, mask=mask,
-                        action=act)
+                        state, agg_grads, t, telemetry=True,
+                        margins=cfg.margins, mask=mask, action=act)
                     tele = finish_telemetry(tele, agg_grads, ddiag)
                     if (self._krum_select_fn is not None
                             and "selection_mask" in ddiag):
@@ -1206,7 +1233,10 @@ class FederatedExperiment:
                               # (core/faults.py): under fault injection
                               # the jitted aggregate resolves 'auto' to
                               # 'xla' and threads the quarantine mask.
-                              and self.faults is None)
+                              and self.faults is None
+                              # Margins read the on-device scores; the
+                              # eager host engines never return them.
+                              and not cfg.margins)
             self._aggregate = (self._aggregate_impl if eager_host_agg
                                else jax.jit(self._aggregate_impl,
                                             **self._donate_kw()))
@@ -1215,17 +1245,20 @@ class FederatedExperiment:
                 # fault seam runs as its own small jitted step between
                 # the (host) attack craft and the aggregation.
                 self._fault_step = jax.jit(inject_and_quarantine)
-            if cfg.telemetry:
+            if cfg.telemetry or cfg.margins:
                 # telemetry is a trace-time (static) flag, so the
-                # telemetry aggregate is its own jitted function.
+                # telemetry aggregate is its own jitted function
+                # (margins ride the same diagnostics pytree).
                 agg_tele = functools.partial(self._aggregate_impl,
-                                             telemetry=True)
+                                             telemetry=True,
+                                             margins=cfg.margins)
                 self._aggregate_tele = (agg_tele if eager_host_agg
                                         else jax.jit(
                                             agg_tele,
                                             **self._donate_kw()))
             self._staged = True
         self._attack_envelope = attack_envelope
+        self._attack_margins = attack_margins
         self._finish_telemetry = finish_telemetry
 
     # ------------------------------------------------------------------
@@ -1297,6 +1330,11 @@ class FederatedExperiment:
                 group_envelope_stats
             )
         tele_on = cfg.telemetry
+        # Margins ride the same diagnostics seam at both tiers
+        # (shard_fn asks the tier-1 kernel, hier_core the tier-2 one);
+        # groupwise secagg is structurally margin-free (config pins
+        # the defense to NoDefense there, which --margins rejects).
+        marg_on = cfg.margins
         # Per-client gradient norms are observable only in the CLEAR
         # hierarchical modes: under groupwise secagg the server sees
         # group sums, not rows, so the shard norm stack (and the
@@ -1307,7 +1345,7 @@ class FederatedExperiment:
         # Any extra per-shard output switches shard_fn to the dict
         # pytree; with everything off the return structure (and the
         # traced program) is byte-for-byte the pre-telemetry tuple.
-        extras = tele_on or cfg.log_round_stats
+        extras = tele_on or cfg.log_round_stats or marg_on
 
         def shard_fn(ids, c_mal, state, t):
             """One megabatch: ids (m,) client ids (malicious first —
@@ -1392,9 +1430,16 @@ class FederatedExperiment:
                 est = self.defense_fn(grads, m, f1)
                 return est.astype(jnp.float32), bad
             out = {"bad": bad}
-            if tele_on:
+            if tele_on or marg_on:
+                dkw = {"margins": True} if marg_on else {}
                 est, diag = self.defense_fn(grads, m, f1,
-                                            telemetry=True)
+                                            telemetry=True, **dkw)
+                if not tele_on:
+                    # Margins-only: the full diagnostics never leave
+                    # the shard — just the margin fields (the stacked
+                    # (S, ...) shard_margin_* record).
+                    diag = {k: v for k, v in diag.items()
+                            if k.startswith("margin_")}
                 out["diag"] = diag
             else:
                 est = self.defense_fn(grads, m, f1)
@@ -1456,20 +1501,23 @@ class FederatedExperiment:
                         env = group_envelope_stats(ests, m)
                         tele["secagg_group_cos_to_mean"] = (
                             env["group_cos_to_mean"])
-            if tele_on:
+            if tele_on or marg_on:
                 if diag1:
                     for dk, dv in diag1.items():
                         tele["shard_" + dk] = dv
-                if norms is not None:
+                if norms is not None and tele_on:
                     tele["shard_grad_norms"] = norms
+                t2kw = {"margins": True} if marg_on else {}
                 agg, diag2 = shard_reduce(tier2_fn, ests, S, f2,
                                           plan=t2_plan,
-                                          telemetry=True)
+                                          telemetry=True, **t2kw)
                 with stage_scope("tier2_aggregate"):
                     for dk, dv in diag2.items():
-                        tele["tier2_" + dk] = dv
-                    tele["tier2_est_norms"] = jnp.linalg.norm(
-                        ests.astype(jnp.float32), axis=1)
+                        if tele_on or dk.startswith("margin_"):
+                            tele["tier2_" + dk] = dv
+                    if tele_on:
+                        tele["tier2_est_norms"] = jnp.linalg.norm(
+                            ests.astype(jnp.float32), axis=1)
             else:
                 agg = shard_reduce(tier2_fn, ests, S, f2,
                                    plan=t2_plan)
@@ -1542,7 +1590,7 @@ class FederatedExperiment:
         donate = self._donate_kw()
         self._fused_round = jax.jit(fused, **donate)
         self._fused_span = jax.jit(fused_span, **donate)
-        if groupwise or cfg.telemetry:
+        if groupwise or cfg.telemetry or cfg.margins:
             self._tele_span = jax.jit(tele_span, static_argnums=2,
                                       **donate)
         self._staged = False
@@ -1652,6 +1700,15 @@ class FederatedExperiment:
                 # NaN-free).
                 crafted = self.attacker.apply(delivered_grads,
                                               self.m_mal, ctx)
+            if cfg.margins:
+                # Attack margins at the delivery seam: pre-attack =
+                # the delivered matrix, crafted = the post-attack one
+                # (attacks/base.py margin_stats).
+                with stage_scope("deliver"):
+                    ms = self.attacker.margin_stats(
+                        delivered_grads, self.m_mal, ctx, crafted=crafted)
+                tele.update(
+                    {"margin_attack_" + k: v for k, v in ms.items()})
             bad = (crafted_nonfinite(crafted)
                    if self._check_attack_nan else jnp.asarray(False))
             with stage_scope("quarantine"):
@@ -1667,14 +1724,17 @@ class FederatedExperiment:
                 bucket = staleness[None, :] == jnp.arange(D)[:, None]
                 tele["async_weight_mass"] = jnp.sum(
                     bucket * w_eff[None, :], axis=1).astype(jnp.float32)
-            if cfg.telemetry:
+            if cfg.telemetry or cfg.margins:
                 upd, ddiag = self._aggregate_impl(
-                    state, agg_grads, t, telemetry=True, mask=delivered,
+                    state, agg_grads, t, telemetry=True,
+                    margins=cfg.margins, mask=delivered,
                     weights=weights)
                 with stage_scope("tier1_aggregate"):
                     for dk, dv in ddiag.items():
-                        tele["defense_" + dk] = dv
-                    tele.update(population_telemetry(agg_grads))
+                        if cfg.telemetry or dk.startswith("margin_"):
+                            tele["defense_" + dk] = dv
+                    if cfg.telemetry:
+                        tele.update(population_telemetry(agg_grads))
             else:
                 upd = self._aggregate_impl(state, agg_grads, t,
                                            mask=delivered,
@@ -1875,10 +1935,11 @@ class FederatedExperiment:
                         (span_name, lambda: self._fused_span.lower(
                             self.state, t0,
                             jnp.asarray(span_len, jnp.int32))))
-                    if cfg.telemetry:
+                    if cfg.telemetry or cfg.margins:
                         # Hierarchical engines ledger their telemetry
                         # span under their own name so the perf gate
-                        # can pin the hier-tele cost cells separately.
+                        # can pin the hier-tele cost cells separately
+                        # (margins ride the same span entry point).
                         entries.append(
                             ("hier_tele_span" if hier else "tele_span",
                              lambda: self._tele_span.lower(
@@ -1899,7 +1960,8 @@ class FederatedExperiment:
                 # (host BLAS) — nothing compiled to analyze there.
                 entries.append(("aggregate", lambda: self._aggregate.lower(
                     self.state, grads_sds, t0)))
-            if cfg.telemetry and hasattr(self._aggregate_tele, "lower"):
+            if ((cfg.telemetry or cfg.margins)
+                    and hasattr(self._aggregate_tele, "lower")):
                 entries.append(
                     ("aggregate_tele", lambda: self._aggregate_tele.lower(
                         self.state, grads_sds, t0)))
@@ -2090,7 +2152,8 @@ class FederatedExperiment:
             return "traffic_span"
         if self.faults is not None:
             return "fault_span"
-        if self.cfg.telemetry or self._secagg is not None:
+        if (self.cfg.telemetry or self.cfg.margins
+                or self._secagg is not None):
             return "hier_tele_span" if hier else "tele_span"
         return "hier_span" if hier else "fused_span"
 
@@ -2121,7 +2184,8 @@ class FederatedExperiment:
             elif self.faults is not None:
                 low = self._fault_span.lower(
                     self.state, t0, int(count), self._fault_state)
-            elif self.cfg.telemetry or self._secagg is not None:
+            elif (self.cfg.telemetry or self.cfg.margins
+                    or self._secagg is not None):
                 low = self._tele_span.lower(self.state, t0, int(count))
             else:
                 # Span length is a traced operand: one compilation
@@ -2243,9 +2307,10 @@ class FederatedExperiment:
                                      jnp.asarray(start, jnp.int32),
                                      int(count), self._fault_state))
                 self.last_span_telemetry = (int(start), stacked)
-            elif self.cfg.telemetry or self._secagg is not None:
-                # secagg rides the telemetry span too: its per-round
-                # protocol stats (sum-check verdicts, recovery counts)
+            elif (self.cfg.telemetry or self.cfg.margins
+                    or self._secagg is not None):
+                # secagg and margins ride the telemetry span too: their
+                # per-round stats (sum-check verdicts / margin fields)
                 # must come back stacked even with cfg.telemetry off,
                 # exactly like the fault counts do under faults.
                 self.state, bad, stacked = self._tele_span(
@@ -2304,15 +2369,19 @@ class FederatedExperiment:
             grads = self._compute_grads(self.state, t, batches)
             tele = (self._attack_envelope(grads, self.state, t)
                     if self.cfg.telemetry else {})
+            pre_attack = grads if self.cfg.margins else None
             grads = self.attacker.apply(grads, self.m_mal,
                                         self._ctx_for(self.state, t))
+            if self.cfg.margins:
+                tele = {**tele, **self._attack_margins(
+                    pre_attack, grads, self.state, t)}
             mask = None
             if self.faults is not None:
                 grads, mask, self._fault_state, fstats = self._fault_step(
                     grads, t, self._fault_state)
                 tele = {**tele, **fstats}
             aux = {}
-            if self.cfg.telemetry:
+            if self.cfg.telemetry or self.cfg.margins:
                 # The defense returns its own diagnostics (single
                 # distance computation; the Krum mask marks the
                 # aggregated row by construction).
@@ -2365,16 +2434,28 @@ class FederatedExperiment:
         """Write one round's telemetry (host values) as 'defense' and
         'attack' events (cfg.telemetry), its 'fault_*' counts as a
         'fault' event, its 'secagg_*' protocol stats as a 'secagg'
-        event (both emitted with or without telemetry), and — for
-        hierarchical rounds — its 'shard_*'/'tier2_*' stacks as one
-        schema-v6 'shard_selection' event; track Krum winners for the
+        event (both emitted with or without telemetry), its margin
+        fields as one schema-v12 'margin' event (cfg.margins — also
+        with or without telemetry), and — for hierarchical rounds —
+        its 'shard_*'/'tier2_*' stacks as one schema-v6
+        'shard_selection' event; track Krum winners for the
         end-of-run selection histogram."""
         defense_fields, attack_fields = {}, {}
         fault_fields, secagg_fields, shard_fields = {}, {}, {}
         async_fields = {}
+        margin_fields, margin_attack, hier_margin = {}, {}, {}
         for k, v in tele.items():
             val = _jsonable(v)
-            if k.startswith("attack_"):
+            # Margin prefixes are checked FIRST: 'defense_margin_*' /
+            # 'shard_margin_*' / 'tier2_margin_*' would otherwise be
+            # swallowed by the defense/shard branches below.
+            if k.startswith("defense_margin_"):
+                margin_fields[k[len("defense_"):]] = val
+            elif k.startswith("margin_attack_"):
+                margin_attack[k[len("margin_attack_"):]] = val
+            elif k.startswith(("shard_margin_", "tier2_margin_")):
+                hier_margin[k] = val
+            elif k.startswith("attack_"):
                 attack_fields[k[len("attack_"):]] = val
             elif k.startswith("async_"):
                 # v7 'async' record: scalar counts land as ints, the
@@ -2406,6 +2487,49 @@ class FederatedExperiment:
             logger.record(kind="async", round=int(t), **async_fields)
         if secagg_fields:
             logger.record(kind="secagg", round=int(t), **secagg_fields)
+        if self.cfg.margins and (margin_fields or margin_attack
+                                 or hier_margin):
+            # One schema-v12 'margin' event per round: the bare defense
+            # margin fields + the colluder-survival rollups
+            # (utils/margins.py), the attack's envelope utilization
+            # ('attack_*'), the hierarchical stacks with their own
+            # rollups, and — when a traffic schedule rides along — the
+            # round's effective-f (the traffic event itself is popped
+            # AFTER this emission in both run loops, so the join reads
+            # it in place).
+            from attacking_federate_learning_tpu.utils.margins import (
+                margin_rollups, hier_margin_rollups, tier2_margin_rollups
+            )
+            ev = dict(margin_fields)
+            ev.update(margin_rollups(margin_fields, self.m_mal))
+            for mk, mv in margin_attack.items():
+                ev["attack_" + mk] = mv
+            if hier_margin:
+                ev.update(hier_margin)
+                shard_stacks = {k[len("shard_"):]: v
+                                for k, v in hier_margin.items()
+                                if k.startswith("shard_margin_")}
+                tier2_fields = {k[len("tier2_"):]: v
+                                for k, v in hier_margin.items()
+                                if k.startswith("tier2_margin_")}
+                if shard_stacks:
+                    mal_counts = list(self._placement.mal_counts)
+                    for rk, rv in hier_margin_rollups(
+                            shard_stacks, mal_counts).items():
+                        ev["shard_" + rk] = rv
+                if tier2_fields:
+                    colluder_shards = [c > 0 for c in
+                                       self._placement.mal_counts]
+                    for rk, rv in tier2_margin_rollups(
+                            tier2_fields, colluder_shards).items():
+                        ev["tier2_" + rk] = rv
+            if self.traffic is not None:
+                tr = self._traffic_events.get(int(t))
+                if tr is not None and "f_eff" in tr:
+                    ev["f_eff"] = int(tr["f_eff"])
+            logger.record(kind="margin", round=int(t),
+                          defense=self.cfg.defense,
+                          malicious_count=self.m_mal, **ev)
         if not self.cfg.telemetry:
             return
         if shard_fields:
@@ -2631,7 +2755,8 @@ class FederatedExperiment:
                         self._book_span_walls(logger, trace_dir, count)
                 else:
                     self.run_span(epoch, count)
-                if ((cfg.telemetry or self.faults is not None
+                if ((cfg.telemetry or cfg.margins
+                        or self.faults is not None
                         or self._secagg is not None
                         or self._async is not None)
                         and self.last_span_telemetry is not None):
@@ -2665,7 +2790,8 @@ class FederatedExperiment:
                     logger.record(kind="round", round=epoch,
                                   **{k: float(v) for k, v in
                                      self.last_round_stats.items()})
-                if ((cfg.telemetry or self.faults is not None
+                if ((cfg.telemetry or cfg.margins
+                        or self.faults is not None
                         or self._secagg is not None
                         or self._async is not None)
                         and fresh(epoch)

@@ -51,6 +51,9 @@ from jax import lax
 
 from attacking_federate_learning_tpu.ops.distances import pairwise_distances
 from attacking_federate_learning_tpu.utils.costs import stage_scope
+from attacking_federate_learning_tpu.utils.margins import (
+    krum_margins, rank_keep_margins
+)
 from attacking_federate_learning_tpu.utils.plugins import Registry
 
 
@@ -78,6 +81,18 @@ def stage_wrapped(fn, stage):
         if hasattr(fn, attr) and not hasattr(scoped, attr):
             setattr(scoped, attr, getattr(fn, attr))
     return scoped
+
+def check_margin_seam(margins, telemetry):
+    """The ``margins=`` seam (ISSUE 18) rides the telemetry diagnostics
+    pytree — margins without telemetry has no carrier and is a caller
+    bug (core/engine.py always passes telemetry=True when margins are
+    on, even with --telemetry off; the engine then filters the
+    non-margin diagnostics back out)."""
+    if margins and not telemetry:
+        raise ValueError(
+            "defense margins=True requires telemetry=True (margin "
+            "fields ride the diagnostics pytree; utils/margins.py)")
+
 
 _INF = jnp.inf
 # topk cancellation guard: required ratio of a row's kept score mass to
@@ -244,14 +259,18 @@ def population_telemetry(users_grads):
 
 @DEFENSES.register("NoDefense")
 def no_defense(users_grads, users_count, corrupted_count, telemetry=False,
-               mask=None, weights=None):
+               mask=None, weights=None, margins=False):
     """Plain FedAvg mean (reference defences.py:13-14).  ``mask`` (the
     quarantine seam, core/faults.py): mean over the alive rows only —
     a zeroed dropout row must not drag the average toward zero.
     ``weights`` (the staleness seam, core/async_rounds.py — requires
     ``mask``): the weighted alive mean ``sum(w_i g_i)/sum(w_i)`` —
-    FedBuff's staleness-discounted aggregate."""
+    FedBuff's staleness-discounted aggregate.  ``margins=`` is
+    accepted and ignored (a mean has no decision boundary to measure;
+    config rejects --margins for a NoDefense tier-1, but the tier-2
+    ``shard_mean`` wrapper forwards the flag here)."""
     check_weight_seam(mask, weights)
+    check_margin_seam(margins, telemetry)
     if weights is not None:
         w = jnp.where(mask, weights, 0.0)
         agg = (w @ users_grads.astype(jnp.float32)) / jnp.maximum(
@@ -479,7 +498,8 @@ def krum_select(users_grads, users_count, corrupted_count,
 @DEFENSES.register("Krum")
 def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
          method="sort", distance_impl="xla", D=None, distance_dtype=None,
-         telemetry=False, mask=None, weights=None, scores_impl="xla"):
+         telemetry=False, mask=None, weights=None, scores_impl="xla",
+         margins=False):
     """Krum selection (reference defences.py:23-42): the single gradient
     whose summed distance to its k nearest peers is minimal.
 
@@ -511,7 +531,16 @@ def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
     the aggregate is bit-exact whenever the (ulp-class) score
     difference between evaluations doesn't flip a near-tie — the
     measured-band contract (tests/test_pallas.py).
+
+    ``margins=True`` (requires ``telemetry=True``; ISSUE 18)
+    additionally returns ``margin_selection`` (n,) — each row's signed
+    score distance to the selection threshold (selected iff > 0, one-
+    sided at exact f32 score ties) — and ``margin_gap`` () — the
+    winner/runner-up score gap (utils/margins.py:krum_margins).  Needs
+    a score-returning engine: the scalar-index host path has no score
+    vector to measure and raises.
     """
+    check_margin_seam(margins, telemetry)
     if not telemetry:
         idx = krum_select(users_grads, users_count, corrupted_count,
                           paper_scoring=paper_scoring, method=method,
@@ -531,11 +560,19 @@ def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
     sel = jnp.zeros((n,), jnp.float32).at[idx].set(1.0)
     agg = (users_grads[idx] * weights[idx] if weights is not None
            else users_grads[idx])
-    return agg, {"selection_mask": sel, "scores": scores_out}
+    diag = {"selection_mask": sel, "scores": scores_out}
+    if margins:
+        if scores is None:
+            raise ValueError(
+                "Krum margins need a score-returning engine; "
+                "distance_impl='host' returns only the winner index "
+                "(defenses/host.py)")
+        diag.update(krum_margins(scores, idx, mask=mask))
+    return agg, diag
 
 
 def trimmed_mean_of(users_grads, number_to_consider, impl="xla",
-                    telemetry=False):
+                    telemetry=False, margins=False):
     """Median-anchored trimmed mean along the client axis.
 
     Per coordinate (reference defences.py:48-51): subtract the median, keep
@@ -557,9 +594,27 @@ def trimmed_mean_of(users_grads, number_to_consider, impl="xla",
     trim (NaN on the host/pallas kernels, which return only the
     aggregate) — 'trim_fraction': () — the per-round fraction of
     clients trimmed per coordinate}``.
+
+    ``margins=True`` (requires ``telemetry=True``; ISSUE 18)
+    additionally returns ``margin_kept_frac``/``margin_boundary_dist``
+    (utils/margins.py:rank_keep_margins) — the kept fraction from rank
+    membership (bit-equal to the scatter-based ``kept_fraction``) and
+    the inside-positive mean distance to the trim boundary.  The
+    reductions are pure-XLA rank ops over the same key the estimator
+    sorts by, so the pallas impl gets REAL margins (its aggregate
+    kernel still reports NaN ``kept_fraction``) and the two impls'
+    margins are bit-identical by construction; the host kernel runs
+    off-device and raises.
     """
+    check_margin_seam(margins, telemetry)
     n = users_grads.shape[0]
     trim_frac = jnp.float32(1.0 - number_to_consider / n)
+
+    def margin_fields():
+        med = jnp.median(users_grads, axis=0)
+        return rank_keep_margins(jnp.abs(users_grads - med[None, :]),
+                                 number_to_consider)
+
     if impl == "pallas":
         from attacking_federate_learning_tpu.ops.pallas_defense import (
             pallas_trimmed_mean_of
@@ -567,9 +622,17 @@ def trimmed_mean_of(users_grads, number_to_consider, impl="xla",
         agg = pallas_trimmed_mean_of(users_grads, int(number_to_consider))
         if not telemetry:
             return agg
-        return agg, {"kept_fraction": jnp.full((n,), jnp.nan, jnp.float32),
-                     "trim_fraction": trim_frac}
+        diag = {"kept_fraction": jnp.full((n,), jnp.nan, jnp.float32),
+                "trim_fraction": trim_frac}
+        if margins:
+            diag.update(margin_fields())
+        return agg, diag
     if impl == "host":
+        if margins:
+            raise ValueError(
+                "trimmed-mean margins need the on-device ranks; "
+                "impl='host' returns only the aggregate "
+                "(defenses/host.py)")
         from attacking_federate_learning_tpu.defenses.host import (
             host_trimmed_mean_of
         )
@@ -591,12 +654,17 @@ def trimmed_mean_of(users_grads, number_to_consider, impl="xla",
     d = users_grads.shape[1]
     kept_frac = (jnp.zeros((n,), jnp.float32)
                  .at[kept_rows.reshape(-1)].add(1.0) / d)
-    return agg, {"kept_fraction": kept_frac, "trim_fraction": trim_frac}
+    diag = {"kept_fraction": kept_frac, "trim_fraction": trim_frac}
+    if margins:
+        diag.update(rank_keep_margins(jnp.abs(dev), number_to_consider,
+                                      order=order))
+    return agg, diag
 
 
 @DEFENSES.register("TrimmedMean")
 def trimmed_mean(users_grads, users_count, corrupted_count, impl="xla",
-                 telemetry=False, mask=None, weights=None):
+                 telemetry=False, mask=None, weights=None,
+                 margins=False):
     """Reference defences.py:44-52; keeps n - f - 1 coordinates.
 
     ``impl='host'`` (opt-in, config ``trimmed_mean_impl``) routes to the
@@ -617,7 +685,13 @@ def trimmed_mean(users_grads, users_count, corrupted_count, impl="xla",
 
     ``weights`` (the staleness seam, core/async_rounds.py — requires
     ``mask``): the trim stays rank-based; the kept deviations average
-    weighted (see :func:`masked_trimmed_mean_of`)."""
+    weighted (see :func:`masked_trimmed_mean_of`).
+
+    ``margins=True``: see :func:`trimmed_mean_of`; the masked variant
+    ranks by the same alive-anchored key as
+    :func:`masked_trimmed_mean_of` (dead rows +inf -> -inf boundary
+    distance, zero kept fraction)."""
+    check_margin_seam(margins, telemetry)
     if mask is not None:
         if impl == "host":
             raise ValueError(
@@ -642,13 +716,23 @@ def trimmed_mean(users_grads, users_count, corrupted_count, impl="xla",
                                          weights=weights)
         if not telemetry:
             return agg
-        return agg, {"kept_fraction": jnp.full((n,), jnp.nan, jnp.float32),
-                     "trim_fraction":
-                     (1.0 - (e - corrupted_count - 1) / jnp.maximum(e, 1)
-                      ).astype(jnp.float32)}
+        diag = {"kept_fraction": jnp.full((n,), jnp.nan, jnp.float32),
+                "trim_fraction":
+                (1.0 - (e - corrupted_count - 1) / jnp.maximum(e, 1)
+                 ).astype(jnp.float32)}
+        if margins:
+            # Same alive-anchored key masked_trimmed_mean_of ranks by
+            # (and the pallas tiles replicate op for op), so the
+            # margins are impl-independent pure-XLA rank ops.
+            med = masked_median(users_grads, mask)
+            key = jnp.where(mask[:, None],
+                            jnp.abs(users_grads - med[None, :]), _INF)
+            k = jnp.maximum(e - corrupted_count - 1, 1)
+            diag.update(rank_keep_margins(key, k))
+        return agg, diag
     number_to_consider = users_grads.shape[0] - corrupted_count - 1
     return trimmed_mean_of(users_grads, number_to_consider, impl=impl,
-                           telemetry=telemetry)
+                           telemetry=telemetry, margins=margins)
 
 
 def host_coordwise(host_fn, users_grads):
@@ -723,7 +807,7 @@ def _bulyan_diag(n, selected, Dm, users_count, corrupted_count,
 def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
            method="sort", distance_impl="xla", D=None, batch_select=1,
            distance_dtype=None, selection_impl="xla", trim_impl="xla",
-           telemetry=False, mask=None, weights=None):
+           telemetry=False, mask=None, weights=None, margins=False):
     """Bulyan (reference defences.py:55-70): iteratively Krum-select
     n - 2f gradients (removing each winner from the pool, with the pool
     size — but not f — shrinking), then trim-mean the selection with
@@ -801,7 +885,23 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
     ``weights`` (the staleness seam, core/async_rounds.py — requires
     ``mask``): selection stays unweighted; the final masked trimmed
     mean over the selected rows averages with their per-row weights
-    (:func:`masked_trimmed_mean_of`)."""
+    (:func:`masked_trimmed_mean_of`).
+
+    ``margins=True`` (requires ``telemetry=True``; ISSUE 18) threads
+    margin carries through the traced selection loop and additionally
+    returns: ``margin_selection`` (n,) — per row, the signed score
+    distance to its trip's selection cut (picks measure against the
+    first unselected score, losers against the final trip's last pick;
+    selected iff > 0, one-sided at exact f32 ties and on the masked
+    variant, whose dead rows are forced to -inf); ``margin_gap`` () —
+    the final trip's pick/runner-up slack; ``margin_slack`` (trips,) —
+    that slack per selection trip; ``margin_trim_kept`` (n,) — the
+    trim-stage kept fraction of each selected row scattered back to
+    its client slot (zero for unselected rows).  Both off-device
+    selection engines raise: the full-host path returns only the
+    aggregate and the hybrid's native selection never ships per-trip
+    scores back."""
+    check_margin_seam(margins, telemetry)
     n, _ = users_grads.shape
     f = corrupted_count
     set_size = users_count - 2 * f
@@ -841,6 +941,11 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
                 raise ValueError(
                     "mask-aware Bulyan has no full-host engine "
                     "(defenses/host.py is maskless)")
+            if margins:
+                raise ValueError(
+                    "Bulyan margins need the traced selection loop; "
+                    "the full-host engine returns only the aggregate "
+                    "(defenses/host.py)")
             from attacking_federate_learning_tpu.defenses.host import (
                 host_bulyan
             )
@@ -863,6 +968,12 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
     Dm = D + jnp.diag(jnp.full((n,), _INF, D.dtype))
 
     if selection_impl == "host":
+        if margins:
+            raise ValueError(
+                "Bulyan margins are incompatible with "
+                "selection_impl='host': the native selection engine "
+                "returns only the selected indices, never the per-trip "
+                "scores the margins measure (native/bulyan_select.cpp)")
         # Hybrid: device distances above, host-native exact selection,
         # device gather + trimmed mean below.
         selected = _host_bulyan_selection_of(
@@ -895,7 +1006,11 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
         dead_sentinel = jnp.float32(3e38)
 
         def body_m(t, carry):
-            remaining, selected = carry
+            if margins:
+                (remaining, selected, margin, slack, cut,
+                 last_scores) = carry
+            else:
+                remaining, selected = carry
             alive_pool = remaining & mask
             # Reference shrinking-pool k, over the ALIVE pool (clamped:
             # a degenerate cohort keeps at least the nearest neighbor).
@@ -907,18 +1022,48 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
             scores = jnp.sum(jnp.where(take, sortedD_m, 0.0), axis=1)
             scores = jnp.where(alive_pool, scores, dead_sentinel)
             scores = jnp.where(remaining, scores, _INF)
-            _, idxs = lax.top_k(-scores, q)
+            if margins:
+                # One extra score (the first unselected, ascending) is
+                # this trip's selection cut — the margin carries ride
+                # the SAME top_k evaluation (its first q entries are
+                # the margins-off picks, ties and all).
+                kk = min(q + 1, n)
+                neg_vals, idxs_all = lax.top_k(-scores, kk)
+                idxs = idxs_all[:q]
+            else:
+                _, idxs = lax.top_k(-scores, q)
             r = jnp.minimum(q, set_size - t * q)
             live = jnp.arange(q) < r
             kill = jnp.zeros((n,), bool).at[idxs].set(live)
             selected = lax.dynamic_update_slice(
                 selected, jnp.where(live, idxs, 0).astype(jnp.int32),
                 (t * q,))
-            return remaining & ~kill, selected
+            if not margins:
+                return remaining & ~kill, selected
+            vals = -neg_vals          # ascending kk smallest scores
+            runner = jnp.take(vals, jnp.minimum(r, kk - 1), mode="clip")
+            last_pick = jnp.take(vals, jnp.maximum(r - 1, 0),
+                                 mode="clip")
+            margin = margin.at[jnp.where(live, idxs, n)].set(
+                runner - vals[:q], mode="drop")
+            slack = slack.at[t].set(runner - last_pick)
+            return (remaining & ~kill, selected, margin, slack,
+                    last_pick, scores)
 
-        _, selected = lax.fori_loop(
-            0, trips_m, body_m,
-            (jnp.ones((n,), bool), jnp.zeros((trips_m * q,), jnp.int32)))
+        if margins:
+            (rem_f, selected, margin_sel, slack, cut,
+             last_scores) = lax.fori_loop(
+                0, trips_m, body_m,
+                (jnp.ones((n,), bool),
+                 jnp.zeros((trips_m * q,), jnp.int32),
+                 jnp.zeros((n,), jnp.float32),
+                 jnp.zeros((trips_m,), jnp.float32),
+                 jnp.float32(0.0), jnp.zeros((n,), jnp.float32)))
+        else:
+            _, selected = lax.fori_loop(
+                0, trips_m, body_m,
+                (jnp.ones((n,), bool),
+                 jnp.zeros((trips_m * q,), jnp.int32)))
         selected = selected[:set_size]
         selection = users_grads[selected]
         # Effective-cohort Bulyan selects e - 2f of the e alive rows.
@@ -949,7 +1094,34 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
         scores0 = _krum_scores(Dm, jnp.sum(mask), corrupted_count,
                                alive=mask, paper_scoring=paper_scoring,
                                method="sort").astype(jnp.float32)
-        return agg, {"selection_mask": dm, "scores": scores0}
+        diag = {"selection_mask": dm, "scores": scores0}
+        if margins:
+            # Losers measure against the final trip's last pick (the
+            # PADDED loop's cut — a lower bound on their distance to
+            # the effective boundary when the cohort is degraded).
+            # Picks the effective-cohort cumsum clipped out of the
+            # selection are rejected rows whose trip-local margins
+            # don't measure against the effective boundary — explicit
+            # -inf ("rejected, unmeasured"), like dead rows, so the
+            # selected-iff-margin>0 identity holds for every alive
+            # row.  Trim-stage survival mirrors the
+            # masked_trimmed_mean_of key over the selected rows.
+            margin_sel = jnp.where(rem_f, cut - last_scores, margin_sel)
+            clipped = jnp.zeros((n,), bool).at[selected].set(~sel_mask)
+            margin_sel = jnp.where(clipped, -_INF, margin_sel)
+            margin_sel = jnp.where(mask, margin_sel, -_INF)
+            med_s = masked_median(selection, sel_mask)
+            key_s = jnp.where(sel_mask[:, None],
+                              jnp.abs(selection - med_s[None, :]), _INF)
+            k_t = jnp.maximum(jnp.sum(sel_mask) - 2 * f - 1, 1)
+            tm = rank_keep_margins(key_s, k_t)
+            diag["margin_selection"] = margin_sel.astype(jnp.float32)
+            diag["margin_gap"] = slack[trips_m - 1]
+            diag["margin_slack"] = slack
+            diag["margin_trim_kept"] = jnp.zeros(
+                (n,), jnp.float32).at[selected].set(
+                jnp.where(sel_mask, tm["margin_kept_frac"], 0.0))
+        return agg, diag
 
     # Presort once for the traced selection loop.
     order = jnp.argsort(Dm, axis=1)
@@ -958,7 +1130,10 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
     trips = -(-set_size // q)
 
     def body(t, carry):
-        alive, selected = carry
+        if margins:
+            alive, selected, margin, slack, cut, last_scores = carry
+        else:
+            alive, selected = carry
         # Pool at trip start: everyone minus the t*q already selected.
         k = users_count - t * q - f - (2 if paper_scoring else 0)
         alive_cols = alive[order]                       # (n, n) gather
@@ -968,17 +1143,42 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
         scores = jnp.where(alive, scores, _INF)
         # q lowest scores, ascending (ties -> lower index, like argmin);
         # only the first r count on the (possibly short) final trip.
-        _, idxs = lax.top_k(-scores, q)
+        if margins:
+            # One extra score — the first unselected, this trip's
+            # selection cut; the first q entries of the widened top_k
+            # are exactly the margins-off picks (same evaluation,
+            # same tie resolution).
+            kk = min(q + 1, n)
+            neg_vals, idxs_all = lax.top_k(-scores, kk)
+            idxs = idxs_all[:q]
+        else:
+            _, idxs = lax.top_k(-scores, q)
         r = jnp.minimum(q, set_size - t * q)
         live = jnp.arange(q) < r
         kill = jnp.zeros((n,), bool).at[idxs].set(live)
         selected = lax.dynamic_update_slice(
             selected, jnp.where(live, idxs, 0).astype(jnp.int32), (t * q,))
-        return alive & ~kill, selected
+        if not margins:
+            return alive & ~kill, selected
+        vals = -neg_vals              # ascending kk smallest scores
+        runner = jnp.take(vals, jnp.minimum(r, kk - 1), mode="clip")
+        last_pick = jnp.take(vals, jnp.maximum(r - 1, 0), mode="clip")
+        margin = margin.at[jnp.where(live, idxs, n)].set(
+            runner - vals[:q], mode="drop")
+        slack = slack.at[t].set(runner - last_pick)
+        return alive & ~kill, selected, margin, slack, last_pick, scores
 
     alive0 = jnp.ones((n,), bool)
     sel0 = jnp.zeros((trips * q,), jnp.int32)
-    _, selected = lax.fori_loop(0, trips, body, (alive0, sel0))
+    if margins:
+        (alive_f, selected, margin_sel, slack, cut,
+         last_scores) = lax.fori_loop(
+            0, trips, body,
+            (alive0, sel0, jnp.zeros((n,), jnp.float32),
+             jnp.zeros((trips,), jnp.float32), jnp.float32(0.0),
+             jnp.zeros((n,), jnp.float32)))
+    else:
+        _, selected = lax.fori_loop(0, trips, body, (alive0, sel0))
     selected = selected[:set_size]
 
     selection = users_grads[selected]  # (set_size, d), in selection order
@@ -986,8 +1186,23 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
     agg = trim_tail(selection, number_to_consider)
     if not telemetry:
         return agg
-    return agg, _bulyan_diag(n, selected, Dm, users_count, corrupted_count,
-                             paper_scoring, method)
+    diag = _bulyan_diag(n, selected, Dm, users_count, corrupted_count,
+                        paper_scoring, method)
+    if margins:
+        # Losers measure against the final trip's last-pick score; the
+        # trim-stage survival re-ranks the selection by the same key
+        # trimmed_mean_of sorts by and scatters each selected row's
+        # kept fraction back to its client slot.
+        margin_sel = jnp.where(alive_f, cut - last_scores, margin_sel)
+        med_s = jnp.median(selection, axis=0)
+        tm = rank_keep_margins(jnp.abs(selection - med_s[None, :]),
+                               number_to_consider)
+        diag["margin_selection"] = margin_sel.astype(jnp.float32)
+        diag["margin_gap"] = slack[trips - 1]
+        diag["margin_slack"] = slack
+        diag["margin_trim_kept"] = jnp.zeros(
+            (n,), jnp.float32).at[selected].set(tm["margin_kept_frac"])
+    return agg, diag
 
 
 # --- tier-2 (cross-shard) entries for hierarchical aggregation ----------
@@ -1028,14 +1243,17 @@ def _alive_to_mask(alive_counts):
 
 
 def shard_mean(shard_estimates, shard_count, corrupted_shards,
-               alive_counts=None, telemetry=False):
+               alive_counts=None, telemetry=False, margins=False):
     """Tier-2 NoDefense: alive-count-weighted mean of the shard
     estimates — with equal megabatches and no faults this is exactly
     the flat FedAvg mean (each estimate already averages m clients);
     with faults the weights restore the flat masked mean's
     per-client weighting.  ``telemetry=True`` returns ``(agg, {})`` —
-    a mean rejects nothing, so there is nothing to attribute."""
+    a mean rejects nothing, so there is nothing to attribute (and
+    ``margins=`` is likewise accepted and ignored: no decision
+    boundary, no margin fields)."""
     del corrupted_shards
+    check_margin_seam(margins, telemetry)
     if alive_counts is None:
         agg = jnp.mean(shard_estimates, axis=0)
     else:

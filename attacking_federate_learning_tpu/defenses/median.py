@@ -15,7 +15,7 @@ from attacking_federate_learning_tpu.defenses.kernels import DEFENSES
 
 @DEFENSES.register("Median")
 def median(users_grads, users_count, corrupted_count, impl="xla",
-           telemetry=False, mask=None, weights=None):
+           telemetry=False, mask=None, weights=None, margins=False):
     """``impl='host'`` (opt-in, config ``median_impl``) routes to the
     native column-blocked kernel (native/bulyan_select.cpp:fl_median) —
     same rationale and same non-auto-dispatch rule as
@@ -35,11 +35,33 @@ def median(users_grads, users_count, corrupted_count, impl="xla",
 
     ``weights`` (the staleness seam, core/async_rounds.py — requires
     ``mask``): the weighted lower median, the value where cumulative
-    weight crosses half the mass (kernels.py:masked_median)."""
+    weight crosses half the mass (kernels.py:masked_median).
+
+    ``margins=True`` (requires ``telemetry=True``; ISSUE 18)
+    additionally returns ``margin_kept_frac``/``margin_boundary_dist``
+    (utils/margins.py:median_pick_margins) — each row's pick mass
+    from the exact rank membership of the median (so the picked values
+    reconstruct the aggregate) and its inside-positive proximity to
+    the rank-derived median.  Pure-XLA rank ops independent of
+    ``impl``, so the pallas route gets bit-identical margins; the
+    off-device host kernel raises."""
     from attacking_federate_learning_tpu.defenses.kernels import (
-        check_weight_seam
+        check_margin_seam, check_weight_seam
     )
     check_weight_seam(mask, weights)
+    check_margin_seam(margins, telemetry)
+    if margins and impl == "host":
+        raise ValueError(
+            "Median margins need the on-device ranks; impl='host' "
+            "returns only the aggregate (defenses/host.py)")
+
+    def margin_fields():
+        from attacking_federate_learning_tpu.utils.margins import (
+            median_pick_margins
+        )
+        return median_pick_margins(users_grads, mask=mask,
+                                   weights=weights)
+
     if mask is not None:
         if impl == "host":
             raise ValueError(
@@ -61,7 +83,10 @@ def median(users_grads, users_count, corrupted_count, impl="xla",
         G = users_grads.astype(jnp.float32)
         dist = jnp.linalg.norm(G - agg.astype(jnp.float32)[None, :],
                                axis=1)
-        return agg, {"dist_to_agg": dist}
+        diag = {"dist_to_agg": dist}
+        if margins:
+            diag.update(margin_fields())
+        return agg, diag
     if impl == "host":
         from attacking_federate_learning_tpu.defenses.host import (
             host_median
@@ -81,4 +106,7 @@ def median(users_grads, users_count, corrupted_count, impl="xla",
         return agg
     G = users_grads.astype(jnp.float32)
     dist = jnp.linalg.norm(G - agg.astype(jnp.float32)[None, :], axis=1)
-    return agg, {"dist_to_agg": dist}
+    diag = {"dist_to_agg": dist}
+    if margins:
+        diag.update(margin_fields())
+    return agg, diag

@@ -43,6 +43,14 @@ the experiment flag surface stays reference-verbatim).  Verbs:
   the host-clock span/eval rollup.  A second query renders the two
   runs' stage-wall diff instead (delta marks fire above 25% — walls
   are measured, so exact-equality marks would flag noise)
+- ``runs margins Q [B]`` — per-defense margin trajectories from a
+  run's schema-v12 ``margin`` events (any --margins run carries them):
+  the colluder-survival ledger (defense-sign colluder margin,
+  selected-colluder count, kept mass) plus the Krum winner/runner-up
+  gap and traffic f_eff per round.  A second query renders the two
+  runs' colluder-margin drift instead — per-round deltas with
+  sign-flip marks (a flip is a defense decision REVERSAL between the
+  runs, the signal the margin-drift gate watches)
 - ``runs selfcheck``    — CI leg: refresh idempotence + resolvability
   over the current run store (tools/smoke.sh leg 6)
 
@@ -808,6 +816,99 @@ def cmd_walls(reg, args):
     return 0
 
 
+def _margin_series_data(events):
+    """The run's v12 margin series, or None when the run carries no
+    margin events (ran without --margins / predates schema v12)."""
+    from attacking_federate_learning_tpu.utils.margins import (
+        margin_series
+    )
+
+    ser = margin_series(events)
+    return ser or None
+
+
+def cmd_margins(reg, args):
+    """Per-defense margin trajectories from a run's schema-v12
+    'margin' events (--margins runs; utils/margins.py:margin_series):
+    the colluder-survival ledger (defense-sign colluder margin,
+    selected-colluder count, kept mass) plus the winner/runner-up gap
+    and traffic f_eff per round.  With a second query, render the
+    cross-run drift instead — per-round colluder-margin deltas with
+    sign-flip marks (a flip is a defense decision reversal, not
+    noise).  Exit 1 when a run carries no margin events."""
+    ents = [reg.resolve(args.query, args.filter)]
+    if args.b is not None:
+        ents.append(reg.resolve(args.b, args.filter))
+    series = []
+    for e in ents:
+        s = _margin_series_data(_load_run_events(e))
+        if s is None:
+            print(f"run {e['run_id']}: no margin events — rerun with "
+                  f"--margins (schema v12+)")
+            return 1
+        series.append(s)
+    if args.json:
+        print(json.dumps({e["run_id"]: s
+                          for e, s in zip(ents, series)}))
+        return 0
+    from attacking_federate_learning_tpu.utils.margins import (
+        SERIES_FIELDS, margin_drift
+    )
+
+    def _cell(v):
+        if v is None:
+            return f"{'-':>10}"
+        if isinstance(v, bool) or isinstance(v, int):
+            return f"{v:>10d}"
+        return f"{float(v):>10.4f}"
+
+    if len(ents) == 1:
+        print(f"== {ents[0]['run_id']} ==")
+        for d, ser in sorted(series[0].items()):
+            fields = [f for f in SERIES_FIELDS
+                      if any(v is not None for v in ser[f])]
+            print(f"  defense {d} ({len(ser['round'])} rounds)")
+            print("    round " + "".join(f"{f:>22}"[-22:] for f in fields))
+            for i, r in enumerate(ser["round"]):
+                print(f"    {r:>5} " + "".join(
+                    f"{'':>12}" + _cell(ser[f][i]) for f in fields))
+            cm = [v for v in ser.get("colluder_margin", [])
+                  if v is not None]
+            if cm:
+                neg = sum(1 for v in cm if v <= 0)
+                print(f"    colluder margin: min {min(cm):+.4f}, "
+                      f"final {cm[-1]:+.4f}, breached (<=0) "
+                      f"{neg}/{len(cm)} rounds")
+        return 0
+    a, b = series
+    ida, idb = ents[0]["run_id"], ents[1]["run_id"]
+    print(f"== margin drift: {ida} vs {idb} ==")
+    for d in sorted(set(a) | set(b)):
+        if d not in a or d not in b:
+            print(f"  defense {d}: only in {ida if d in a else idb}")
+            continue
+        dr = margin_drift(a[d], b[d])
+        if not dr["rounds"]:
+            print(f"  defense {d}: no shared rounds")
+            continue
+        print(f"  defense {d}  (colluder_margin: A, B, delta)")
+        a_by_r = dict(zip(a[d]["round"], a[d]["colluder_margin"]))
+        b_by_r = dict(zip(b[d]["round"], b[d]["colluder_margin"]))
+        for r, delta in zip(dr["rounds"], dr["delta"]):
+            va, vb = a_by_r.get(r), b_by_r.get(r)
+            mark = "   <-- sign flip" if r in dr["sign_flips"] else ""
+            dtxt = f"{delta:>+13.4f}" if delta is not None else f"{'-':>13}"
+            print(f"    round {r:>4}{_cell(va):>13}{_cell(vb):>13}"
+                  f"{dtxt}{mark}")
+        if dr["sign_flips"]:
+            print(f"    sign flips at rounds: "
+                  + " ".join(str(r) for r in dr["sign_flips"]))
+        else:
+            print("    no sign flips (defense decisions stable "
+                  "across runs)")
+    return 0
+
+
 def cmd_selfcheck(reg, args):
     """CI self-check (tools/smoke.sh leg 6): two refreshes must agree
     (incremental refresh is idempotent over an unchanged store), every
@@ -947,6 +1048,16 @@ def main(argv=None) -> int:
     sp.add_argument("b", nargs="?", default=None,
                     help="second run: diff B against the first")
     sp.set_defaults(fn=cmd_walls)
+    sp = sub.add_parser("margins",
+                        help="per-defense margin trajectories from v12 "
+                             "'margin' events (--margins runs); a "
+                             "second query renders the cross-run "
+                             "colluder-margin drift with sign-flip "
+                             "marks")
+    sp.add_argument("query")
+    sp.add_argument("b", nargs="?", default=None,
+                    help="second run: drift of B against the first")
+    sp.set_defaults(fn=cmd_margins)
     sp = sub.add_parser("selfcheck",
                         help="CI: refresh idempotence + resolvability")
     sp.set_defaults(fn=cmd_selfcheck)

@@ -72,7 +72,15 @@ the defense-validity watchdog's ladder decision
 (action='remask'/'fallback'/'hold', with the cohort pids, f_eff and
 the defense actually applied riding along) — host-born from the
 PRNG-replayable schedule, so ``replay_traffic`` diffs the emitted
-stream against an independent regeneration.
+stream against an independent regeneration; v12 adds ``margin`` —
+one robustness-margin record per round under ``--margins``
+(core/engine.py + utils/margins.py): the defenses' in-jit decision
+margins (Krum winner/runner-up gap and per-row signed distance to the
+selection threshold, trim-boundary distances and kept-coordinate
+fractions, Bulyan per-iteration selection slack) rolled up host-side
+into the colluder-survival ledger (colluder_margin /
+colluder_selected / colluder_kept_mass), with the attack's envelope
+utilization and traffic's f_eff riding along.
 Readers accept every version; older logs simply never carry the newer
 kinds, and a newer-only kind stamped with an older version is an
 emitter bug, rejected (``KIND_MIN_VERSION``).
@@ -90,8 +98,8 @@ from typing import Optional
 import numpy as np
 
 
-SCHEMA_VERSION = 11
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11)
+SCHEMA_VERSION = 12
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12)
 
 # kind -> required fields.  Producers: core/engine.py (round, eval, asr,
 # profile, stream, defense, attack, selection_hist via RunLogger).
@@ -214,6 +222,18 @@ EVENT_KINDS = {
     # from the PRNG-replayable schedule (replay_traffic diffs the
     # emitted stream against an independent regeneration)
     "traffic": {"round", "arrived", "action"},
+    # --- v12: the robustness-margin observatory (utils/margins.py) ------
+    # one record per round under --margins: the defense's in-jit
+    # decision margins stripped to bare names (selection margins, gap,
+    # trim kept fractions / boundary distances, Bulyan slack), the
+    # host-side colluder-survival rollups (colluder_margin — the
+    # DEFENSE-side worst margin over the malicious rows, <= 0 when a
+    # colluder survives selection — colluder_selected, kept-mass
+    # splits), the attack's envelope-utilization stats ('attack_*'),
+    # the hierarchical per-shard/tier-2 stacks ('shard_margin_*' /
+    # 'tier2_margin_*' with their own rollups) and traffic's f_eff
+    # when a --traffic-population schedule rides along
+    "margin": {"round", "defense"},
 }
 
 # Minimum schema version per kind introduced after v1; an event carrying
@@ -224,7 +244,7 @@ KIND_MIN_VERSION = {"compile": 2, "cost": 2, "heartbeat": 2,
                     "secagg": 5, "shard_selection": 6, "forensics": 6,
                     "async": 7, "campaign": 8,
                     "stage_cost": 9, "wire_bytes": 9,
-                    "wall": 10, "traffic": 11}
+                    "wall": 10, "traffic": 11, "margin": 12}
 
 # Back-compat alias (pre-v3 spelling used by external readers).
 V2_KINDS = {k for k, v in KIND_MIN_VERSION.items() if v == 2}

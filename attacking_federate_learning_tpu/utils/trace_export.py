@@ -25,6 +25,11 @@ directly:
   on a "tier-2 forensics" track naming the rejected set, and a
   **forensics** verdict becomes an instant on the same track — the
   colluder-localization story as a timeline;
+- **margin** rounds (schema v12, --margins) become a
+  ``colluder_margin`` counter track next to ``tier2_rejected`` — the
+  defense-sign colluder margin per round, so a robustness collapse is
+  literally the counter crossing zero on the timeline (rounds without
+  a finite margin draw no point);
 - the end-of-run **profile** summary (PhaseTimer) is laid out as
   sequential "X" spans on a phases track (aggregates, not real
   intervals — count/mean ride in args);
@@ -49,6 +54,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import math
 import os
 from typing import Optional
 
@@ -222,6 +228,18 @@ def events_to_trace(events, name: str = "run") -> dict:
                               "ph": "i", "pid": pid,
                               "tid": _TID_FORENSICS, "ts": _us(t),
                               "s": "t", "args": args})
+        elif kind == "margin":
+            # Robustness-margin ledger (schema v12, --margins): the
+            # defense-sign colluder margin as a counter track next to
+            # tier2_rejected — a collapse is the counter crossing zero.
+            # Rounds without a finite margin (an async empty delivery
+            # makes no decision) draw no point rather than a NaN the
+            # viewer can't parse.
+            cm = e.get("colluder_margin")
+            if isinstance(cm, (int, float)) and math.isfinite(cm):
+                trace.append({"name": "colluder_margin", "ph": "C",
+                              "pid": pid, "tid": 0, "ts": _us(t),
+                              "args": {"colluder_margin": float(cm)}})
         elif kind in _INSTANT_KINDS:
             label = kind if kind != "lifecycle" else (
                 f"lifecycle:{e.get('phase', '?')}")

@@ -337,7 +337,13 @@ def test_schema_roundtrip_every_engine_kind(tmp_path):
                      traffic=TrafficConfig(population=48, rate=0.8,
                                            seed=3))
     _, ev7 = _run(cfg7, tmp_path, "roundtrip7")
-    for rec in ev1 + ev2 + ev3 + ev4 + ev5 + ev6 + ev7:
+    # Run 8: robustness margins — the v12 'margin' kind from a real
+    # engine run (utils/margins.py rollups, one event per round).
+    cfg8 = _tele_cfg(tmp_path, users_count=12, mal_prop=0.25,
+                     defense="Krum", epochs=3, test_step=3,
+                     margins=True)
+    _, ev8 = _run(cfg8, tmp_path, "roundtrip8")
+    for rec in ev1 + ev2 + ev3 + ev4 + ev5 + ev6 + ev7 + ev8:
         validate_event(rec)
         assert rec["v"] == SCHEMA_VERSION
         seen.add(rec["kind"])

@@ -35,7 +35,11 @@ utils/walls.py, whose stages + unattributed_us partition the booked
 total exactly — plus v11's 'traffic' kind: one population-traffic
 record per round under --traffic-population runs, core/population.py
 — arrived/f_eff cohort accounting and the defense-validity watchdog's
-ladder action, replayable on host via replay_traffic).  An
+ladder action, replayable on host via replay_traffic — plus v12's
+'margin' kind: one robustness-margin record per round under --margins
+runs, core/engine.py + utils/margins.py — per-row defense decision
+margins, the colluder-survival rollups and the attack-side envelope
+utilization).  An
 event stamped with a
 version this reader does not know is reported as "produced by a newer
 writer" — a clear per-line error, never a KeyError — and a newer-only
@@ -48,6 +52,9 @@ Usage:
     python tools/check_events.py logs/*.jsonl
     python tools/check_events.py --strict run.jsonl   # free-form lines
                                                       # are errors too
+    python tools/check_events.py --stats run.jsonl    # per-kind count +
+                                                      # schema-version
+                                                      # histogram
 
 Lines that are valid JSON objects WITHOUT a 'kind' field are counted as
 legacy/free-form rows and skipped by default (pre-schema logs — e.g. the
@@ -100,6 +107,32 @@ def check_file(path, strict=False):
     return counts, legacy, errors
 
 
+def file_stats(path):
+    """Per-kind stats over one file's typed rows — ``{kind: {"count":
+    n, "versions": {v: n}}}`` — without validating (the histogram of a
+    malformed file is still informative).  Free-form rows carry no
+    kind/version stamp and are excluded; a typed row without a 'v'
+    stamp counts under version 1 (the pre-stamp writer)."""
+    stats: dict = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(rec, dict) or "kind" not in rec:
+                continue
+            row = stats.setdefault(str(rec["kind"]),
+                                   {"count": 0, "versions": {}})
+            row["count"] += 1
+            v = rec.get("v", 1)
+            row["versions"][v] = row["versions"].get(v, 0) + 1
+    return stats
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description=f"Validate run JSONLs against the event schema "
@@ -109,6 +142,9 @@ def main(argv=None) -> int:
     p.add_argument("--strict", action="store_true",
                    help="rows without a 'kind' field are errors, not "
                         "legacy free-form lines")
+    p.add_argument("--stats", action="store_true",
+                   help="also print the per-kind count and "
+                        "schema-version histogram for each file")
     args = p.parse_args(argv)
 
     failed = False
@@ -127,6 +163,14 @@ def main(argv=None) -> int:
         else:
             print(f"ok   {path}: {sum(counts.values())} events  "
                   f"[{kinds}]{tail}")
+        if args.stats:
+            stats = file_stats(path)
+            print(f"  kind              count  versions")
+            for kind in sorted(stats):
+                row = stats[kind]
+                vs = " ".join(f"v{v}:{n}" for v, n in
+                              sorted(row["versions"].items()))
+                print(f"    {kind:<15} {row['count']:>6}  {vs}")
     return 1 if failed else 0
 
 

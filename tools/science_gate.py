@@ -137,6 +137,33 @@ CELLS = {
                                             churn_dwell=3,
                                             fallback_defense="TrimmedMean",
                                             seed=17)),
+    # --- PR 18: robustness margins (ISSUE 18, utils/margins.py).  The
+    # GRID round-5 Bulyan z=1.5 pair (19 clients, 20% malicious,
+    # style_strength 0.5, 30 rounds — margins need the full study
+    # length; the tie structure only breaks after convergence starts),
+    # now pinned through the MARGIN observatory.  The MEASURED
+    # mechanism, sharper than the working hypothesis of a simple sign
+    # flip: under IID the identical crafted rows are score-degenerate,
+    # so a selected colluder's runner-up is its own twin and the
+    # colluder margin is EXACTLY zero (equal f32 scores subtract to
+    # zero under any legal schedule) — the selection is tie-locked at
+    # the decision boundary ~28/30 rounds, colluders are almost never
+    # selected by a strictly positive margin (2 round-events), and
+    # training collapses to ~10%.  Under femnist_style the honest
+    # rows' per-client structure widens the cohort sigma, the crafted
+    # cluster stops straddling the cut, and the tie-lock BREAKS from
+    # ~round 19: strictly-signed margins appear and PERSIST (19/30 tie
+    # rounds, 11 strict-selection events) while training converges —
+    # the round-5 rescue, restated as the margin leaving the decision
+    # boundary.  All margin metrics are selection-mediated (banded);
+    # the collapse/rescue bands do not overlap.
+    "bulyan_margin_collapse": dict(defense="Bulyan", z=1.5,
+                                   mal_prop=0.2, margins=True,
+                                   rounds=30),
+    "bulyan_margin_rescue": dict(defense="Bulyan", z=1.5, mal_prop=0.2,
+                                 margins=True, rounds=30,
+                                 partition="femnist_style",
+                                 style_strength=0.5),
 }
 
 # Per-metric tolerance bands (absolute; 0 = exact).  Authored here,
@@ -189,6 +216,25 @@ CELL_BANDS = {
     # mechanism, now over per-round sampled rows); the schedule facts
     # are exact host replays (band 0 via the metric defaults).
     "traffic_krum_churn": {"final_accuracy": 3.0, "max_accuracy": 3.0},
+    # Margin cells: every metric reads the f32 distance scores the
+    # selections rest on, so all carry selection-mediated bands; the
+    # DISCRIMINATORS (margin_tie_rounds 28 vs 19, band 3/4;
+    # colluder_selected_total 2 vs 11, band 3/4) keep non-overlapping
+    # bands, so a legal ulp flip cannot turn one cell into the other.
+    "bulyan_margin_collapse": {"final_accuracy": 5.0,
+                               "max_accuracy": 5.0,
+                               "margin_tie_rounds": 3,
+                               "colluder_margin_min": 1.2,
+                               "colluder_margin_final": 0.05,
+                               "margin_breached_rounds": 2,
+                               "colluder_selected_total": 3},
+    "bulyan_margin_rescue": {"final_accuracy": 5.0,
+                             "max_accuracy": 5.0,
+                             "margin_tie_rounds": 4,
+                             "colluder_margin_min": 0.5,
+                             "colluder_margin_final": 0.3,
+                             "margin_breached_rounds": 2,
+                             "colluder_selected_total": 4},
 }
 
 
@@ -223,6 +269,11 @@ def measure_cell(name: str, spec: dict, rounds: int = ROUNDS) -> dict:
     )
     from attacking_federate_learning_tpu.data.datasets import load_dataset
 
+    # A cell may pin its own length (the margin cells ride the GRID
+    # round-5 30-round protocol — the tie structure they pin only
+    # breaks after convergence starts); everything else runs at the
+    # gate cadence.
+    rounds = spec.get("rounds", rounds)
     backdoor = spec.get("backdoor", False)
     attacked = spec.get("attack", "alie") is not None or backdoor
     cfg = ExperimentConfig(
@@ -240,6 +291,9 @@ def measure_cell(name: str, spec: dict, rounds: int = ROUNDS) -> dict:
         megabatch=spec.get("megabatch", 0),
         tier2_defense=spec.get("tier2_defense"),
         mal_placement=spec.get("mal_placement", "spread"),
+        margins=bool(spec.get("margins")),
+        partition=spec.get("partition", "iid"),
+        style_strength=spec.get("style_strength", 0.25),
         async_buffer=spec.get("async_buffer", 0),
         async_max_staleness=spec.get("async_max_staleness", 2),
         staleness_weight=spec.get("staleness_weight", "none"),
@@ -255,12 +309,24 @@ def measure_cell(name: str, spec: dict, rounds: int = ROUNDS) -> dict:
         attacker = DriftAttack(cfg.num_std)
     exp = FederatedExperiment(cfg, attacker=attacker, dataset=ds)
 
-    accs, winners, shard_events = [], [], []
+    accs, winners, shard_events, margin_rounds = [], [], [], []
     hier = cfg.aggregation == "hierarchical"
     eval_rounds = {t for t in range(rounds)
                    if t % cfg.test_step == 0 or t == rounds - 1}
     for t in range(rounds):
         exp.run_round(t)
+        if cfg.margins and exp.last_round_telemetry is not None:
+            # The colluder-survival rollup over the round's margin
+            # fields — the same reduction the engine's v12 'margin'
+            # event carries (utils/margins.py:margin_rollups).
+            from attacking_federate_learning_tpu.utils.margins import (
+                margin_rollups
+            )
+            mf = {k[len("defense_"):]: np.asarray(v)
+                  for k, v in exp.last_round_telemetry.items()
+                  if k.startswith("defense_margin_")}
+            if mf:
+                margin_rounds.append(margin_rollups(mf, exp.m_mal))
         if cfg.telemetry and exp.last_round_telemetry is not None:
             if hier:
                 # Rebuild the round's 'shard_selection' payload the
@@ -310,6 +376,21 @@ def measure_cell(name: str, spec: dict, rounds: int = ROUNDS) -> dict:
         if "mal_rejected_rounds" in t2:
             out["mal_rejected_rounds"] = t2["mal_rejected_rounds"]
             out["tier2_malicious_share"] = t2["malicious_share"]
+    if margin_rounds:
+        cms = [r["colluder_margin"] for r in margin_rounds
+               if r.get("colluder_margin") is not None]
+        out["colluder_margin_min"] = round(float(min(cms)), 4)
+        out["colluder_margin_final"] = round(float(cms[-1]), 4)
+        out["margin_breached_rounds"] = sum(1 for v in cms if v <= 0)
+        out["colluder_selected_total"] = int(sum(
+            r.get("colluder_selected", 0) for r in margin_rounds))
+        # The tie ledger the PR-18 acceptance pins: rounds where the
+        # colluder margin sits EXACTLY at the selection cut (0.0 — a
+        # selected colluder's runner-up is its identical twin, and
+        # equal f32 scores subtract to an exact zero).  A collapse run
+        # is tie-locked nearly every round; a rescue run breaks the
+        # lock (strictly-signed margins appear and persist).
+        out["margin_tie_rounds"] = sum(1 for v in cms if v == 0.0)
     if backdoor:
         out["final_asr"] = round(
             float(exp.attacker.test_asr(exp.state.weights)), 4)

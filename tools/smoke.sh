@@ -6,9 +6,10 @@
 # secure-aggregation smoke + hierarchical-telemetry/forensics smoke +
 # asynchronous-rounds smoke + campaign-engine kill/resume smoke +
 # measured-walls smoke (profiled run, runs walls, wall gate) +
-# population-traffic smoke (churn run, ladder audit, runs traffic).
+# population-traffic smoke (churn run, ladder audit, runs traffic) +
+# robustness-margins smoke (margin run, v12 audit, runs margins drift).
 #
-#   bash tools/smoke.sh            # all thirteen, CPU-pinned
+#   bash tools/smoke.sh            # all fourteen, CPU-pinned
 #   bash tools/smoke.sh --fast     # skip the fault + crash matrices
 #                                  # (the two slowest legs)
 #
@@ -76,7 +77,14 @@
 #      degradation ladder), check_events over its private log (schema
 #      v11 'traffic' events), a replay audit (emitted events must
 #      equal core/population.py:replay_traffic exactly, with at least
-#      one degraded round), and 'runs traffic <id>' exit-0.
+#      one degraded round), and 'runs traffic <id>' exit-0;
+#  14. robustness-margins smoke — two journaled 6-round --margins x
+#      Bulyan runs at different seeds (schema-v12 'margin' events:
+#      per-row decision margins + colluder-survival rollups,
+#      utils/margins.py), check_events --stats over the private logs
+#      (v12 kind + per-kind histogram), a margin-event audit (one per
+#      round, rollup fields present), 'runs margins <id>' exit-0 on
+#      one run, and the cross-run drift render over both.
 #
 # Exit: nonzero if any leg fails.  Always CPU (the gates' baselines are
 # CPU artifacts, and the matrices must not touch a TPU capture).
@@ -91,33 +99,33 @@ fail=0
 shopt -s nullglob
 jsonls=(logs/*.jsonl)
 if [ ${#jsonls[@]} -gt 0 ]; then
-    echo "== smoke 1/13: check_events (${#jsonls[@]} logs) =="
+    echo "== smoke 1/14: check_events (${#jsonls[@]} logs) =="
     python tools/check_events.py "${jsonls[@]}" || fail=1
 else
-    echo "== smoke 1/13: check_events — no logs/*.jsonl yet, skipped =="
+    echo "== smoke 1/14: check_events — no logs/*.jsonl yet, skipped =="
 fi
 
 crash_work=""
 if [ "${1:-}" != "--fast" ]; then
-    echo "== smoke 2/13: fault_matrix =="
+    echo "== smoke 2/14: fault_matrix =="
     python tools/fault_matrix.py || fail=1
-    echo "== smoke 3/13: crash_matrix (supervised preempt/resume) =="
+    echo "== smoke 3/14: crash_matrix (supervised preempt/resume) =="
     # Keep the matrix's run stores: leg 6 registry-checks them.
     crash_work="$(mktemp -d -t crash_matrix_XXXXXX)"
     python tools/crash_matrix.py --workdir "$crash_work" || fail=1
 else
-    echo "== smoke 2/13: fault_matrix — skipped (--fast) =="
-    echo "== smoke 3/13: crash_matrix — skipped (--fast) =="
+    echo "== smoke 2/14: fault_matrix — skipped (--fast) =="
+    echo "== smoke 3/14: crash_matrix — skipped (--fast) =="
 fi
 
-echo "== smoke 4/13: perf_gate (+ memproof + wireproof + pallasproof"
+echo "== smoke 4/14: perf_gate (+ memproof + wireproof + pallasproof"
 echo "   + shardproof + stageproof) =="
 python tools/perf_gate.py --memproof || fail=1
 
-echo "== smoke 5/13: science_gate (behavioral drift) =="
+echo "== smoke 5/14: science_gate (behavioral drift) =="
 python tools/science_gate.py || fail=1
 
-echo "== smoke 6/13: runs selfcheck (registry) =="
+echo "== smoke 6/14: runs selfcheck (registry) =="
 python -m attacking_federate_learning_tpu.cli runs selfcheck || fail=1
 if [ -n "$crash_work" ]; then
     # The registry over the crash matrix's preempt/resume artifacts:
@@ -134,7 +142,7 @@ if [ -n "$crash_work" ]; then
     rm -rf "$crash_work"
 fi
 
-echo "== smoke 7/13: hierarchical aggregation (journaled, audited) =="
+echo "== smoke 7/14: hierarchical aggregation (journaled, audited) =="
 hier_work="$(mktemp -d -t hier_smoke_XXXXXX)"
 for def in Krum TrimmedMean; do
     python -m attacking_federate_learning_tpu.cli \
@@ -160,7 +168,7 @@ sys.exit(bad)
 PY
 rm -rf "$hier_work"
 
-echo "== smoke 8/13: secure aggregation (journaled, audited) =="
+echo "== smoke 8/14: secure aggregation (journaled, audited) =="
 sa_work="$(mktemp -d -t secagg_smoke_XXXXXX)"
 # vanilla: one dropout-rate high enough that the 5-round seeded run is
 # guaranteed (and pinned by the audit below) to include at least one
@@ -209,7 +217,7 @@ sys.exit(bad)
 PY
 rm -rf "$sa_work"
 
-echo "== smoke 9/13: hierarchical telemetry + forensics (journaled) =="
+echo "== smoke 9/14: hierarchical telemetry + forensics (journaled) =="
 fx_work="$(mktemp -d -t hier_tele_smoke_XXXXXX)"
 # 5-round journaled hierarchical x Krum run with --telemetry: the run
 # must emit one schema-v6 'shard_selection' event per round.
@@ -246,7 +254,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     trace hier_tele_smoke -o "$fx_work/trace.json" || fail=1
 rm -rf "$fx_work"
 
-echo "== smoke 10/13: asynchronous rounds (journaled, audited) =="
+echo "== smoke 10/14: asynchronous rounds (journaled, audited) =="
 as_work="$(mktemp -d -t async_smoke_XXXXXX)"
 # 5-round journaled FedBuff runs: k=8 of n=12 aggregated per applied
 # round, staleness bound 2, poly weighting, Krum + TrimmedMean.
@@ -296,7 +304,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     async async_Krum_smoke || fail=1
 rm -rf "$as_work"
 
-echo "== smoke 11/13: campaign engine (kill + resume, audited) =="
+echo "== smoke 11/14: campaign engine (kill + resume, audited) =="
 ce_work="$(mktemp -d -t campaign_smoke_XXXXXX)"
 cat > "$ce_work/spec.json" <<SPEC
 {"name": "smoke",
@@ -348,7 +356,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     campaign "$camp_id" || fail=1
 rm -rf "$ce_work"
 
-echo "== smoke 12/13: measured walls (profiled run + wall gate) =="
+echo "== smoke 12/14: measured walls (profiled run + wall gate) =="
 wl_work="$(mktemp -d -t walls_smoke_XXXXXX)"
 # 5-round journaled flat x Krum with every eval interval profiled: the
 # engine books each span capture onto the stage taxonomy and emits
@@ -394,7 +402,7 @@ python tools/wall_gate.py --update --baseline "$wl_work/WALL_BASELINE.json" \
 python tools/wall_gate.py --baseline "$wl_work/WALL_BASELINE.json" || fail=1
 rm -rf "$wl_work"
 
-echo "== smoke 13/13: population traffic (churn, ladder, audited) =="
+echo "== smoke 13/14: population traffic (churn, ladder, audited) =="
 tr_work="$(mktemp -d -t traffic_smoke_XXXXXX)"
 # 10-round journaled churn run from an unreliable 16-client population:
 # the sampled cohort routinely misses Krum's 2f+3 validity bound, so
@@ -453,6 +461,56 @@ python -m attacking_federate_learning_tpu.cli runs \
     --run-dir "$tr_work/runs" --bench '' --progress '' \
     traffic traffic_smoke || fail=1
 rm -rf "$tr_work"
+
+echo "== smoke 14/14: robustness margins (v12 audit + drift render) =="
+mg_work="$(mktemp -d -t margins_smoke_XXXXXX)"
+# Two short journaled Bulyan --margins runs at different seeds: the
+# in-jit margin observatory emits one schema-v12 'margin' event per
+# round (per-row decision margins + colluder-survival rollups).
+for seed in 0 1; do
+    python -m attacking_federate_learning_tpu.cli \
+        -d Bulyan -z 1.5 -s SYNTH_MNIST -n 15 -m 0.2 -c 16 -e 6 \
+        --synth-train 256 --synth-test 64 --seed "$seed" \
+        --margins \
+        --journal --run-id "margins_smoke_$seed" --no-checkpoint \
+        --log-dir "$mg_work/logs" --run-dir "$mg_work/runs" \
+        > /dev/null || fail=1
+    # The private log validates (v12 'margin' events included) and the
+    # --stats histogram renders.
+    python tools/check_events.py --stats \
+        "$mg_work/logs/margins_smoke_$seed.jsonl" || fail=1
+done
+# Margin-event audit: one per round, rollup fields riding along.
+python - "$mg_work" <<'PY' || fail=1
+import json, os, sys
+bad = 0
+for seed in (0, 1):
+    events = [json.loads(line) for line in
+              open(os.path.join(sys.argv[1], "logs",
+                                f"margins_smoke_{seed}.jsonl"))]
+    mg = [e for e in events if e.get("kind") == "margin"]
+    problems = []
+    if len(mg) != 6:
+        problems.append(f"{len(mg)} margin events, want one per round")
+    if any(e.get("v", 0) < 12 for e in mg):
+        problems.append("margin event stamped below v12")
+    if any("colluder_margin" not in e or "margin_gap" not in e
+           for e in mg):
+        problems.append("a margin event is missing its rollups")
+    status = "ok" if not problems else f"FAIL {problems}"
+    print(f"  margins margins_smoke_{seed}: {len(mg)} events ({status})")
+    bad |= bool(problems)
+sys.exit(bad)
+PY
+# Registry-resolved trajectory table (exit 0), then the cross-run
+# colluder-margin drift with sign-flip marks over both seeds.
+python -m attacking_federate_learning_tpu.cli runs \
+    --run-dir "$mg_work/runs" --bench '' --progress '' \
+    margins margins_smoke_0 || fail=1
+python -m attacking_federate_learning_tpu.cli runs \
+    --run-dir "$mg_work/runs" --bench '' --progress '' \
+    margins margins_smoke_0 margins_smoke_1 || fail=1
+rm -rf "$mg_work"
 
 if [ $fail -ne 0 ]; then
     echo "SMOKE FAILED"
