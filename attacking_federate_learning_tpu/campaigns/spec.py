@@ -183,12 +183,6 @@ def validate_composition(cfg: ExperimentConfig,
                 "hierarchical aggregation requires "
                 "data_placement='device' (the scanned round gathers "
                 "each megabatch's batch on device)")
-        if cfg.faults is not None and cfg.faults.enabled:
-            raise ValueError(
-                "hierarchical aggregation does not support fault "
-                "injection yet (the quarantine mask spans the full "
-                "cohort); the tier-2 kernels' alive_counts seam is in "
-                "place for when it lands")
         if cfg.backdoor and not cfg.backdoor_fused:
             raise ValueError(
                 "hierarchical aggregation needs the fused backdoor "
@@ -525,7 +519,10 @@ def cfg_to_cli_args(cfg: ExperimentConfig, attack: str = "auto") -> list:
                  "--fault-straggler", str(f.straggler),
                  "--fault-straggler-delay", str(f.straggler_delay),
                  "--fault-corrupt", str(f.corrupt),
-                 "--fault-corrupt-mode", f.corrupt_mode]
+                 "--fault-corrupt-mode", f.corrupt_mode,
+                 "--fault-shard-dropout", str(f.shard_dropout),
+                 "--fault-shard-dropout-dwell",
+                 str(f.shard_dropout_dwell)]
     if attack not in (None, "auto"):
         args += ["--attack", attack]
     return args

@@ -351,6 +351,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "finite bit-scaled rows ('scale' — what the "
                         "robust defense / divergence watchdog must "
                         "absorb)")
+    p.add_argument("--fault-shard-dropout", default=0.0, type=float,
+                   metavar="P",
+                   help="per-SHARD-DOMAIN per-round failure onset "
+                        "probability (hierarchical only): a dead domain "
+                        "loses its whole megabatch for "
+                        "--fault-shard-dropout-dwell rounds, its tier-1 "
+                        "estimate is excluded at tier-2 (alive_counts "
+                        "seam) and the host-planned remask -> fallback "
+                        "-> hold ladder degrades the tier-2 kernel when "
+                        "too few shards survive (core/faults.py)")
+    p.add_argument("--fault-shard-dropout-dwell", default=1, type=int,
+                   metavar="K",
+                   help="rounds a dead shard domain stays dead after "
+                        "each failure onset (correlated outage width)")
     p.add_argument("--traffic-population", default=0, type=int,
                    metavar="P",
                    help="population & traffic engine (core/population.py): "
@@ -480,12 +494,16 @@ def config_from_args(args) -> ExperimentConfig:
     if args.mesh_shape and args.mesh_shape.lower() != "none":
         mesh_shape = tuple(int(x) for x in args.mesh_shape.split(","))
     faults = None
-    if args.fault_dropout or args.fault_straggler or args.fault_corrupt:
-        faults = C.FaultConfig(dropout=args.fault_dropout,
-                               straggler=args.fault_straggler,
-                               corrupt=args.fault_corrupt,
-                               straggler_delay=args.fault_straggler_delay,
-                               corrupt_mode=args.fault_corrupt_mode)
+    if (args.fault_dropout or args.fault_straggler or args.fault_corrupt
+            or args.fault_shard_dropout):
+        faults = C.FaultConfig(
+            dropout=args.fault_dropout,
+            straggler=args.fault_straggler,
+            corrupt=args.fault_corrupt,
+            straggler_delay=args.fault_straggler_delay,
+            corrupt_mode=args.fault_corrupt_mode,
+            shard_dropout=args.fault_shard_dropout,
+            shard_dropout_dwell=args.fault_shard_dropout_dwell)
     traffic = None
     if args.traffic_population > 0:
         traffic = C.TrafficConfig(

@@ -57,6 +57,15 @@ class FaultConfig:
       pre-aggregation), ``'scale'`` multiplies it by ``corrupt_scale``
       (finite garbage: what the robust aggregation itself — or, failing
       that, the divergence watchdog — must absorb).
+    - ``shard_dropout``: the correlated shard-DOMAIN axis
+      (hierarchical aggregation only): each megabatch/device domain
+      draws a per-round death onset with this probability and stays
+      dead for ``shard_dropout_dwell`` consecutive rounds — a whole
+      megabatch vanishes at once (rack/device loss), its tier-1
+      estimate is excluded from tier-2 through the ``alive_counts``
+      seam, and the tier-2 defense-validity watchdog degrades through
+      the remask → bounds-valid-fallback → hold ladder
+      (core/population.py ordering) when too few shards survive.
 
     The watchdog fields govern server-side graceful degradation
     (core/engine.py): at span boundaries a non-finite or norm-exploded
@@ -68,6 +77,8 @@ class FaultConfig:
     dropout: float = 0.0
     straggler: float = 0.0
     corrupt: float = 0.0
+    shard_dropout: float = 0.0   # correlated shard-domain death rate
+    shard_dropout_dwell: int = 1  # rounds a dead domain stays dead
     straggler_delay: int = 1     # rounds of staleness (ring-buffer depth)
     corrupt_mode: str = "nan"    # 'nan' | 'inf' | 'scale'
     corrupt_scale: float = 1e30  # multiplier for corrupt_mode='scale'
@@ -77,11 +88,15 @@ class FaultConfig:
     seed: Optional[int] = None   # None -> derived from the experiment seed
 
     def __post_init__(self):
-        for name in ("dropout", "straggler", "corrupt"):
+        for name in ("dropout", "straggler", "corrupt", "shard_dropout"):
             v = getattr(self, name)
             if not (0.0 <= v < 1.0):
                 raise ValueError(
                     f"fault {name} rate must be in [0, 1), got {v}")
+        if self.shard_dropout_dwell < 1:
+            raise ValueError(
+                f"shard_dropout_dwell must be >= 1, got "
+                f"{self.shard_dropout_dwell}")
         if self.straggler_delay < 1:
             raise ValueError(
                 f"straggler_delay must be >= 1, got {self.straggler_delay}")
@@ -98,7 +113,8 @@ class FaultConfig:
 
     @property
     def enabled(self) -> bool:
-        return (self.dropout > 0 or self.straggler > 0 or self.corrupt > 0)
+        return (self.dropout > 0 or self.straggler > 0
+                or self.corrupt > 0 or self.shard_dropout > 0)
 
 
 @dataclasses.dataclass
@@ -828,11 +844,13 @@ class ExperimentConfig:
             if self.faults is not None and (self.faults.straggler > 0
                                             or self.faults.corrupt > 0):
                 raise ValueError(
-                    "--secagg composes only with --fault-dropout "
-                    "(dropout is the secure-aggregation protocol "
-                    "event: a mask-reconstruction round); "
-                    "--fault-straggler/--fault-corrupt mutate the "
-                    "masked wire, which the protocol cannot model yet")
+                    "--secagg composes only with --fault-dropout / "
+                    "--fault-shard-dropout (dropout is the secure-"
+                    "aggregation protocol event: a mask-reconstruction "
+                    "round; a dead shard domain drops its whole "
+                    "group); --fault-straggler/--fault-corrupt mutate "
+                    "the masked wire, which the protocol cannot model "
+                    "yet")
         if self.local_steps < 1:
             raise ValueError(
                 f"local_steps must be >= 1, got {self.local_steps}")

@@ -251,10 +251,18 @@ class FederatedExperiment:
             # inside their own buffers (core/async_rounds.py) — the
             # sync fault ring never exists there.
             from attacking_federate_learning_tpu.core.faults import (
-                init_fault_state
+                init_fault_state, init_hier_fault_state
             )
-            self._fault_state = init_fault_state(self.faults, self.m,
-                                                 self.flat.dim)
+            if self._placement is not None:
+                # Hier ring: one (m, d) slab per shard per delay slot
+                # (same total bytes as the flat full-participation
+                # ring; empty pytree when stragglers are off).
+                self._fault_state = init_hier_fault_state(
+                    self.faults, self._placement.num_shards,
+                    self._placement.megabatch, self.flat.dim)
+            else:
+                self._fault_state = init_fault_state(
+                    self.faults, self.m, self.flat.dim)
         else:
             self._fault_state = None
         if self._async is not None:
@@ -355,18 +363,25 @@ class FederatedExperiment:
         The client axis lives inside a scanned device program, so every
         feature that needs the materialized (n, d) matrix — or a host
         hop per round — is rejected here rather than failing deep in a
-        trace: fault injection (the
-        quarantine mask is an (n,) row mask over the full matrix),
-        partial participation (cohort sampling composes with placement
-        in a follow-up), host streaming (one round per program by
-        design), and the opt-in host kernels (a pure_callback per
-        megabatch per scan step would marshal more than it saves).
-        Telemetry and round-stats are SUPPORTED (ISSUE 8): per-shard
-        tier-1 diagnostics ride the scan as stacked fixed-shape
-        pytrees — (S, m)-shaped, never (n,)-shaped, so the O(m·d)
-        memory contract survives — and the tier-2 kernels emit their
-        (S,)-shaped shard-selection record ('shard_selection' events,
-        schema v6)."""
+        trace: partial participation (cohort sampling composes with
+        placement in a follow-up), host streaming (one round per
+        program by design), and the opt-in host kernels (a
+        pure_callback per megabatch per scan step would marshal more
+        than it saves).  Telemetry and round-stats are SUPPORTED
+        (ISSUE 8): per-shard tier-1 diagnostics ride the scan as
+        stacked fixed-shape pytrees — (S, m)-shaped, never
+        (n,)-shaped, so the O(m·d) memory contract survives — and the
+        tier-2 kernels emit their (S,)-shaped shard-selection record
+        ('shard_selection' events, schema v6).  Fault injection is
+        SUPPORTED (ISSUE 19): the per-client draw becomes a per-shard
+        (m,) quarantine mask inside the scan step (mask-aware tier-1
+        kernels unchanged), the straggler ring grows a shard axis
+        ((delay, S, m, d) — sequential scan only,
+        core/faults.py:check_fault_support rejects straggler ⊕ SPMD),
+        and the correlated shard-DOMAIN axis (--fault-shard-dropout)
+        kills whole megabatches at once, excluded at tier-2 via the
+        alive_counts seam with a host-planned remask → fallback →
+        hold ladder on the surviving-shard count."""
         cfg = self.cfg
         from attacking_federate_learning_tpu.defenses.kernels import (
             TIER2_DEFENSES, check_tier2_args
@@ -384,12 +399,6 @@ class FederatedExperiment:
                 "hierarchical aggregation requires "
                 "data_placement='device' (the scanned round gathers "
                 "each megabatch's batch on device)")
-        if cfg.faults is not None and cfg.faults.enabled:
-            raise ValueError(
-                "hierarchical aggregation does not support fault "
-                "injection yet (the quarantine mask spans the full "
-                "cohort); the tier-2 kernels' alive_counts seam is in "
-                "place for when it lands")
         if cfg.backdoor and not cfg.backdoor_fused:
             raise ValueError(
                 "hierarchical aggregation needs the fused backdoor "
@@ -1347,17 +1356,13 @@ class FederatedExperiment:
         # traced program) is byte-for-byte the pre-telemetry tuple.
         extras = tele_on or cfg.log_round_stats or marg_on
 
-        def shard_fn(ids, c_mal, state, t):
-            """One megabatch: ids (m,) client ids (malicious first —
-            the per-megabatch mirror of the rows-[0, f) invariant),
-            c_mal its STATIC malicious count.  Returns the (d,) f32
-            tier-1 estimate and the megabatch's nan flag (plus, under
-            groupwise secagg, the group's bitwise sum-check verdict).
-            With telemetry/round-stats on it returns a dict pytree
-            carrying the tier-1 diagnostics (``diag`` — the flat
-            kernel's telemetry on THIS shard's sub-matrix, stacked by
-            client_map into the (S, ...) shard_selection record) and,
-            in the clear modes, the per-row gradient norms."""
+        def megabatch_grads(ids, c_mal, state, t):
+            """Deliver + train + attack for one megabatch — the shared
+            front half of the clear and faulted scan steps (a Python
+            extraction, not a trace change: the fault seam only ever
+            APPENDS ops after it, so the faults=None program is
+            byte-identical).  Returns the crafted (m, d) matrix and
+            the megabatch's nan flag."""
             if self.traffic is not None:
                 # Hier traffic = in-program slot resampling only: each
                 # megabatch slot re-draws its population archetype per
@@ -1400,6 +1405,20 @@ class FederatedExperiment:
                         grads[:c_mal].astype(jnp.float32))).any()
                     if (self._check_attack_nan and c_mal > 0)
                     else jnp.asarray(False))
+            return grads, bad
+
+        def shard_fn(ids, c_mal, state, t):
+            """One megabatch: ids (m,) client ids (malicious first —
+            the per-megabatch mirror of the rows-[0, f) invariant),
+            c_mal its STATIC malicious count.  Returns the (d,) f32
+            tier-1 estimate and the megabatch's nan flag (plus, under
+            groupwise secagg, the group's bitwise sum-check verdict).
+            With telemetry/round-stats on it returns a dict pytree
+            carrying the tier-1 diagnostics (``diag`` — the flat
+            kernel's telemetry on THIS shard's sub-matrix, stacked by
+            client_map into the (S, ...) shard_selection record) and,
+            in the clear modes, the per-row gradient norms."""
+            grads, bad = megabatch_grads(ids, c_mal, state, t)
             if groupwise:
                 # NET-SA composition: the group's rows are secure-
                 # aggregated (masks keyed on these GLOBAL client ids,
@@ -1586,6 +1605,279 @@ class FederatedExperiment:
             (s, bad), stacked = jax.lax.scan(
                 body, (state, jnp.asarray(False)), jnp.arange(count))
             return s, bad, stacked
+
+        if self.faults is not None:
+            # Faulted hierarchical round (ISSUE 19): two fault
+            # granularities compose inside the same scanned program —
+            # per-CLIENT faults become a per-shard (m,) quarantine mask
+            # into the unchanged mask-aware tier-1 kernel, and the
+            # correlated shard-DOMAIN axis kills whole megabatches at
+            # once, excluded at tier-2 through the alive_counts seam.
+            # The tier-2 graceful-degradation ladder is the traffic
+            # engine's (core/population.py plan_action over the
+            # SURVIVING-shard count vs f2): planned on host per round
+            # (pure in (fault key, t) — resume regenerates it),
+            # selected on device, no data-dependent shapes.
+            from attacking_federate_learning_tpu.core.faults import (
+                TIER2_FALLBACK, apply_shard_faults, domain_alive_row,
+                quarantine
+            )
+            from attacking_federate_learning_tpu.core.population import (
+                TRAFFIC_FALLBACK
+            )
+            from attacking_federate_learning_tpu.defenses.kernels import (
+                TIER2_DEFENSES
+            )
+
+            faults = self.faults
+            fkey = self._fault_key
+            straggler = faults.straggler > 0
+            # Ladder step: the masked shard-median fallback kernel
+            # (core/faults.py TIER2_FALLBACK — the widest-validity
+            # tier-2 kernel, f-free over survivors).
+            self._tier2_fallback_fn = stage_wrapped(
+                TIER2_DEFENSES[TIER2_FALLBACK], "tier2_aggregate")
+
+            def fault_shard_fn(sid, ids, c_mal, state, t, ring):
+                """Faulted megabatch step: the clear front half
+                (megabatch_grads — byte-identical trace) plus the
+                fault seam.  ``sid`` is the shard id threaded by
+                client_map(with_sid=True) — the fault draw is pure in
+                (fault key, t, sid), so the host schedule
+                (core/faults.py hier_fault_schedule) replays every
+                count exactly.  ``ring`` is the (delay, S, m, d) stale
+                slab (a unit f32 dummy when straggler is off).
+                Returns a dict pytree; client_map stacks it (S, ...)"""
+                grads, bad = megabatch_grads(ids, c_mal, state, t)
+                with stage_scope("quarantine"):
+                    old = (ring[jnp.mod(t, faults.straggler_delay), sid]
+                           if straggler else None)
+                    faulted, drop, fstats, fresh = apply_shard_faults(
+                        grads, t, sid, fkey, old, faults, c_mal)
+                    # Full (S,) domain row indexed at sid: every shard
+                    # computes the same row (XLA CSEs the copies under
+                    # the sequential scan; under shard_map each device
+                    # derives it locally — no cross-shard operand).
+                    dom = domain_alive_row(fkey, t, S, faults)[sid]
+                out = {"bad": bad}
+                for sk, sv in fstats.items():
+                    out["f_" + sk] = sv
+                if straggler:
+                    out["fresh"] = fresh
+                if groupwise:
+                    # Groupwise secagg ⊕ dropout (config admits only
+                    # dropout-style faults here): the dropped members'
+                    # pairwise masks are reconstructed over the group's
+                    # GLOBAL client ids (recovery_residue), the group
+                    # sum excludes them, and the masked NoDefense mean
+                    # divides by the survivor count — exactly the clear
+                    # quarantine semantics, behind the protocol.
+                    qmask = ~drop
+                    recovered, sstats = secagg_group(
+                        faulted, self._secagg_key, t, ids, alive=qmask)
+                    out["secagg"] = sstats
+                    with stage_scope("quarantine"):
+                        out["f_quarantined"] = (
+                            m - jnp.sum(qmask)).astype(jnp.int32)
+                    if tele_on:
+                        est, diag = self.defense_fn(
+                            recovered, m, f1, mask=qmask, telemetry=True)
+                        out["diag"] = diag
+                    else:
+                        est = self.defense_fn(recovered, m, f1,
+                                              mask=qmask)
+                else:
+                    with stage_scope("quarantine"):
+                        clean, qmask, qstats = quarantine(faulted, drop)
+                    out["f_quarantined"] = qstats["fault_quarantined"]
+                    if tele_on or marg_on:
+                        dkw = {"margins": True} if marg_on else {}
+                        est, diag = self.defense_fn(
+                            clean, m, f1, mask=qmask, telemetry=True,
+                            **dkw)
+                        if not tele_on:
+                            diag = {k: v for k, v in diag.items()
+                                    if k.startswith("margin_")}
+                        out["diag"] = diag
+                    else:
+                        est = self.defense_fn(clean, m, f1, mask=qmask)
+                    if want_norms:
+                        with stage_scope("deliver"):
+                            # Norms of the QUARANTINED matrix — what
+                            # the server actually aggregates.
+                            out["norms"] = jnp.linalg.norm(
+                                clean.astype(jnp.float32), axis=1)
+                # Effective cohort: quarantine survivors, zeroed whole
+                # when the shard's DOMAIN is dead this round — the
+                # tier-2 alive_counts seam excludes alive == 0 shards.
+                with stage_scope("quarantine"):
+                    out["alive"] = (jnp.sum(qmask)
+                                    * dom).astype(jnp.int32)
+                out["est"] = est.astype(jnp.float32)
+                return out
+
+            def fault_hier_core(state, t, action, fstate):
+                ring = (fstate["stale"] if straggler
+                        else jnp.ones((), jnp.float32))
+                with stage_scope("tier1_aggregate"):
+                    out = client_map(fault_shard_fn, place, state, t,
+                                     ring, plan=cm_plan, with_sid=True)
+                ests, bads, alive = out["est"], out["bad"], out["alive"]
+                fstate2 = fstate
+                if straggler:
+                    with stage_scope("quarantine"):
+                        # One ring write per round, outside the scan:
+                        # client_map stacks ``fresh`` (S, m, d) in sid
+                        # order — exactly the ring's shard axis.
+                        fstate2 = {"stale":
+                                   jax.lax.dynamic_update_index_in_dim(
+                                       ring, out["fresh"],
+                                       jnp.mod(t,
+                                               faults.straggler_delay),
+                                       0)}
+                with stage_scope("quarantine"):
+                    dom = domain_alive_row(fkey, t, S, faults)
+                    # NaN-safety: a shard with zero aggregable rows has
+                    # an undefined tier-1 estimate (0/0 mean); zero it
+                    # before tier-2 (whose mask already excludes it) so
+                    # nothing non-finite can leak through an unselected
+                    # lane.
+                    ests = jnp.where(alive[:, None] > 0, ests,
+                                     jnp.zeros((), ests.dtype))
+                    tele = {
+                        "fault_injected_dropout": jnp.sum(
+                            out["f_injected_dropout"]).astype(jnp.int32),
+                        "fault_injected_straggler": jnp.sum(
+                            out["f_injected_straggler"]).astype(
+                                jnp.int32),
+                        "fault_injected_corrupt": jnp.sum(
+                            out["f_injected_corrupt"]).astype(jnp.int32),
+                        "fault_quarantined": jnp.sum(
+                            out["f_quarantined"]).astype(jnp.int32),
+                        "fault_shards_dead": (
+                            S - jnp.sum(dom)).astype(jnp.int32),
+                        "fault_shard_alive": alive.astype(jnp.int32),
+                        "fault_shards_alive": jnp.sum(
+                            alive > 0).astype(jnp.int32),
+                        "fault_tier2_action": jnp.asarray(action,
+                                                          jnp.int32),
+                    }
+                if groupwise:
+                    sa = out["secagg"]
+                    with stage_scope("protect"):
+                        tele.update({
+                            "secagg_sum_check_ok": jnp.all(
+                                sa["secagg_sum_check_ok"] > 0).astype(
+                                    jnp.int32),
+                            "secagg_groups": jnp.asarray(S, jnp.int32),
+                            "secagg_dropped": jnp.sum(
+                                sa["secagg_dropped"]).astype(jnp.int32),
+                            "secagg_masks_reconstructed": jnp.sum(
+                                sa["secagg_masks_reconstructed"]
+                            ).astype(jnp.int32),
+                            "secagg_recovery": jnp.any(
+                                sa["secagg_recovery"] > 0).astype(
+                                    jnp.int32),
+                            "secagg_group_sum_norms":
+                                jnp.linalg.norm(ests, axis=1) * m,
+                        })
+                        if tele_on:
+                            env = group_envelope_stats(ests, m)
+                            tele["secagg_group_cos_to_mean"] = (
+                                env["group_cos_to_mean"])
+                norms = out.get("norms")
+                if tele_on or marg_on:
+                    diag1 = out.get("diag")
+                    if diag1:
+                        for dk, dv in diag1.items():
+                            tele["shard_" + dk] = dv
+                    if norms is not None and tele_on:
+                        tele["shard_grad_norms"] = norms
+                    t2kw = {"margins": True} if marg_on else {}
+                    agg, diag2 = shard_reduce(tier2_fn, ests, S, f2,
+                                              alive_counts=alive,
+                                              plan=t2_plan,
+                                              telemetry=True, **t2kw)
+                    with stage_scope("tier2_aggregate"):
+                        for dk, dv in diag2.items():
+                            if tele_on or dk.startswith("margin_"):
+                                tele["tier2_" + dk] = dv
+                        if tele_on:
+                            tele["tier2_est_norms"] = jnp.linalg.norm(
+                                ests.astype(jnp.float32), axis=1)
+                else:
+                    agg = shard_reduce(tier2_fn, ests, S, f2,
+                                       alive_counts=alive, plan=t2_plan)
+                # Ladder on device: the fallback estimate is always
+                # computed (fixed shapes), the host-planned action
+                # selects.  Telemetry/margins diagnostics above always
+                # read the CONFIGURED tier-2 kernel — under FALLBACK
+                # only the aggregate switches (documented,
+                # ARCHITECTURE.md "Faults & recovery").
+                fb = shard_reduce(self._tier2_fallback_fn, ests, S, f2,
+                                  alive_counts=alive, plan=t2_plan)
+                agg = jnp.where(action == TRAFFIC_FALLBACK, fb, agg)
+                # HOLD rides _aggregate_impl's action seam (state-level
+                # jnp.where after the momentum update).
+                new_state = self._aggregate_impl(state, None, t, agg=agg,
+                                                 action=action)
+                bad = (bads.any() if self._check_attack_nan
+                       else jnp.asarray(False))
+                diag = {}
+                if cfg.log_round_stats:
+                    with stage_scope("apply"):
+                        diag = {
+                            "update_norm": jnp.linalg.norm(
+                                new_state.velocity),
+                            "faded_lr": faded_learning_rate(
+                                cfg.learning_rate, cfg.fading_rate, t),
+                        }
+                        if norms is not None:
+                            diag.update(
+                                grad_norm_mean=jnp.mean(norms),
+                                grad_norm_max=jnp.max(norms),
+                                grad_norm_min=jnp.min(norms))
+                        else:
+                            gs = jnp.linalg.norm(
+                                ests.astype(jnp.float32), axis=1) * m
+                            diag.update(
+                                group_sum_norm_mean=jnp.mean(gs),
+                                group_sum_norm_max=jnp.max(gs),
+                                group_sum_norm_min=jnp.min(gs))
+                return new_state, diag, bad, tele, fstate2
+
+            def fault_fused(state, t, action, fstate, batches=None):
+                # `batches` mirrors the flat faulted signature
+                # (run_round always passes it); hierarchical is
+                # device-resident-only, so it is always None.
+                return fault_hier_core(state, t, action, fstate)
+
+            def fault_span(state, t0, count, fstate, actions):
+                # Hier fault span: the flat fault_span's shape (scan,
+                # static count, stacked 'fault_*' pytree, fault state
+                # in the carry) plus the host-planned (count,) ladder
+                # actions as a scanned operand.
+                def body(carry, xs):
+                    s, bad, fs = carry
+                    i, act = xs
+                    s2, _, b, tele, fs = fault_hier_core(
+                        s, t0 + i, act, fs)
+                    if self._check_attack_nan:
+                        bad = bad | b
+                    return (s2, bad, fs), tele
+
+                (s, bad, fs), stacked = jax.lax.scan(
+                    body, (state, jnp.asarray(False), fstate),
+                    (jnp.arange(count), actions))
+                return s, bad, fs, stacked
+
+            # The fault paths never donate (flat rationale: the fault
+            # state rides the carry and the stacked-scan outputs add
+            # aliasing surface).
+            self._fused_round = jax.jit(fault_fused)
+            self._fault_span = jax.jit(fault_span, static_argnums=2)
+            self._staged = False
+            return
 
         donate = self._donate_kw()
         self._fused_round = jax.jit(fused, **donate)
@@ -2182,8 +2474,13 @@ class FederatedExperiment:
                     jax.ShapeDtypeStruct((c,), jnp.int32),
                     self._fault_state)
             elif self.faults is not None:
-                low = self._fault_span.lower(
-                    self.state, t0, int(count), self._fault_state)
+                if self._placement is not None:
+                    low = self._fault_span.lower(
+                        self.state, t0, int(count), self._fault_state,
+                        jax.ShapeDtypeStruct((int(count),), jnp.int32))
+                else:
+                    low = self._fault_span.lower(
+                        self.state, t0, int(count), self._fault_state)
             elif (self.cfg.telemetry or self.cfg.margins
                     or self._secagg is not None):
                 low = self._tele_span.lower(self.state, t0, int(count))
@@ -2233,6 +2530,24 @@ class FederatedExperiment:
             self.registry, start, count, self.m, self.m_mal,
             self.cfg.defense, self.traffic.fallback_defense,
             self.traffic.min_cohort)
+
+    def _fault_plan(self, start: int, count: int):
+        """Host-planned tier-2 ladder actions for the faulted
+        hierarchical rounds [start, start+count): replay the fault
+        schedule (core/faults.py hier_fault_schedule — pure in the
+        fault key and the round index, so a resumed run regenerates
+        the identical plan), then run the traffic engine's
+        plan_action on each round's SURVIVING-shard count vs the
+        tier-2 kernel's validity bound (f2).  Returns a (count,)
+        int32 np array of TRAFFIC_* codes — one scanned device
+        operand row per round."""
+        from attacking_federate_learning_tpu.core.faults import (
+            hier_fault_schedule, plan_tier2_actions
+        )
+        rows = hier_fault_schedule(self._fault_key, start, count,
+                                   self._placement, self.faults)
+        return plan_tier2_actions([r["shards_alive"] for r in rows],
+                                  self._tier2_name, self._tier2_f)
 
     def run_span(self, start: int, count: int) -> ServerState:
         """Run ``count`` rounds [start, start+count) as one scanned device
@@ -2302,10 +2617,21 @@ class FederatedExperiment:
             elif self.faults is not None:
                 # Fault spans always scan (the stacked per-round pytree
                 # carries the 'fault_*' counts even without telemetry).
-                self.state, bad, self._fault_state, stacked = (
-                    self._fault_span(self.state,
-                                     jnp.asarray(start, jnp.int32),
-                                     int(count), self._fault_state))
+                # Hierarchical fault spans additionally consume the
+                # host-planned tier-2 ladder actions (one row per
+                # round; _fault_plan is pure in (fault key, t)).
+                if self._placement is not None:
+                    acts = self._fault_plan(int(start), int(count))
+                    self.state, bad, self._fault_state, stacked = (
+                        self._fault_span(self.state,
+                                         jnp.asarray(start, jnp.int32),
+                                         int(count), self._fault_state,
+                                         jnp.asarray(acts)))
+                else:
+                    self.state, bad, self._fault_state, stacked = (
+                        self._fault_span(self.state,
+                                         jnp.asarray(start, jnp.int32),
+                                         int(count), self._fault_state))
                 self.last_span_telemetry = (int(start), stacked)
             elif (self.cfg.telemetry or self.cfg.margins
                     or self._secagg is not None):
@@ -2354,9 +2680,16 @@ class FederatedExperiment:
                     jnp.asarray(sched.arrived[0]),
                     jnp.asarray(sched.action[0]), self._fault_state)
             elif self.faults is not None:
-                (self.state, diag, bad, tele,
-                 self._fault_state) = self._fused_round(
-                    self.state, t, self._fault_state, batches)
+                if self._placement is not None:
+                    act = self._fault_plan(t_host, 1)[0]
+                    (self.state, diag, bad, tele,
+                     self._fault_state) = self._fused_round(
+                        self.state, t, jnp.asarray(act, jnp.int32),
+                        self._fault_state, batches)
+                else:
+                    (self.state, diag, bad, tele,
+                     self._fault_state) = self._fused_round(
+                        self.state, t, self._fault_state, batches)
             else:
                 self.state, diag, bad, tele = self._fused_round(
                     self.state, t, batches)
@@ -2464,7 +2797,12 @@ class FederatedExperiment:
                     int(val) if isinstance(val, float)
                     and float(val).is_integer() else val)
             elif k.startswith("fault_"):
-                fault_fields[k[len("fault_"):]] = int(val)
+                # Scalar counts land as ints; the hierarchical
+                # per-shard survivor vector ('fault_shard_alive',
+                # (S,)) as an int list.
+                fault_fields[k[len("fault_"):]] = (
+                    [int(x) for x in val] if isinstance(val, list)
+                    else int(val))
             elif k.startswith("secagg_"):
                 # Scalar counts/flags land as ints, the groupwise
                 # sum-norm vector as a float list.

@@ -29,6 +29,29 @@ Seams:
   dropped rows, zeroes them (so the distance engines never see
   NaN/Inf), and hands the effective-cohort mask to the mask-aware
   defense kernels (defenses/kernels.py ``mask=`` seam).
+
+Hierarchical fault domains (ISSUE 19): under ``aggregation=
+'hierarchical'`` the same PRNG discipline extends to two granularities.
+(a) Per-client faults draw per MEGABATCH — :func:`shard_fault_masks`
+folds the shard id into the round key, so every shard owns a distinct
+replayable stream and the (m,) quarantine mask feeds the UNCHANGED
+mask-aware tier-1 kernel inside the scan step; the straggler ring
+grows a shard axis (``(delay, S, m, d)``, :func:`init_hier_fault_state`)
+and each scan step reads/writes only its shard's slab.  (b) The
+correlated shard-DOMAIN axis (``FaultConfig.shard_dropout``) kills
+whole megabatches at once: :func:`domain_alive_row` draws a per-domain
+death onset per round and holds it for ``shard_dropout_dwell`` rounds
+(a dwell-windowed schedule — pure in ``(key, t)``, so it runs
+identically inside the scanned program, across resume boundaries, and
+in the host replay).  A dead domain's tier-1 estimate flows into
+tier-2 with ``alive_counts == 0`` and is excluded by the shard_*
+kernels' mask seam; the tier-2 defense-validity watchdog
+(:func:`plan_tier2_actions`, extending the PR 17 traffic ladder to
+``f2`` vs surviving shards) plans remask → bounds-valid-fallback →
+hold on the host, and the device program selects on the planned int —
+no data-dependent shapes anywhere.  :func:`hier_fault_schedule` is the
+host ground truth tools/fault_matrix.py diffs emitted 'fault' events
+against, per-shard counts included.
 """
 
 from __future__ import annotations
@@ -48,12 +71,33 @@ MASK_AWARE_DEFENSES = ("NoDefense", "Krum", "TrimmedMean", "Bulyan",
 
 
 def check_fault_support(cfg):
-    """Fail fast on configs the fault model cannot honor (engine init)."""
+    """Fail fast on configs the fault model cannot honor (engine init
+    AND campaigns/spec.py pre-validation — both call this exact
+    function, so the pre-check message and the construction message
+    cannot drift)."""
     if cfg.defense not in MASK_AWARE_DEFENSES:
         raise ValueError(
             f"faults need a mask-aware defense {MASK_AWARE_DEFENSES}, "
             f"got {cfg.defense!r} (the quarantine mask must reach the "
             f"kernel; defenses/kernels.py)")
+    if (cfg.faults.shard_dropout > 0
+            and cfg.aggregation != "hierarchical"):
+        raise ValueError(
+            "--fault-shard-dropout models correlated shard-DOMAIN "
+            "death and needs --aggregation hierarchical (+ "
+            "--megabatch): flat and async rounds have no megabatch/"
+            "device domains to kill — use --fault-dropout for "
+            "per-client loss there")
+    if (cfg.faults.straggler > 0 and cfg.aggregation == "hierarchical"
+            and cfg.mesh_shape is not None
+            and tuple(cfg.mesh_shape)[0] > 1):
+        raise ValueError(
+            "straggler faults do not compose with the hierarchical "
+            "SPMD client_map (--mesh-shape clients axis > 1): the "
+            "(delay, S, m, d) stale ring buffer is a cross-round carry "
+            "the shard_map program cannot thread — run the sequential "
+            "scan (clients axis 1) or drop --fault-straggler "
+            "(dropout/corrupt/shard-dropout are stateless and compose)")
     if cfg.faults.straggler > 0 and cfg.participation < 1.0:
         raise ValueError(
             "straggler faults need participation=1.0: the stale ring "
@@ -179,3 +223,177 @@ def quarantine(grads, dropped):
     stats = {"fault_quarantined":
              (grads.shape[0] - jnp.sum(mask)).astype(jnp.int32)}
     return clean, mask, stats
+
+
+# ---------------------------------------------------------------------------
+# hierarchical fault domains (ISSUE 19)
+
+# The domain schedule's own sub-stream: folded once on top of the fault
+# key so shard-domain onsets never collide with the per-client draws.
+_DOMAIN_SALT = 0x5AD0
+
+# Tier-2 ladder fallback (the coordinate-wise bounds-valid default,
+# mirroring TrafficConfig.fallback_defense's default): when the
+# configured tier-2 defense's validity bound fails against the
+# surviving-shard count, the round degrades to the masked shard median.
+TIER2_FALLBACK = "Median"
+
+
+def init_hier_fault_state(faults, num_shards, megabatch, d):
+    """Hier mirror of :func:`init_fault_state`: the straggler ring
+    grows a shard axis — ``{'stale': (delay, S, m, d) f32}`` — so each
+    megabatch scan step reads/writes only its own ``(m, d)`` slab
+    (slot ``t % delay``, row ``sid``).  Total bytes equal the flat
+    full-participation ring (delay · n · d).  Empty pytree when
+    stragglers are off (dropout/corrupt/shard-dropout are stateless).
+    """
+    if faults.straggler > 0:
+        return {"stale": jnp.zeros(
+            (faults.straggler_delay, num_shards, megabatch, d),
+            jnp.float32)}
+    return {}
+
+
+def shard_fault_masks(key, t, sid, m, c_mal, faults):
+    """Per-megabatch mirror of :func:`fault_masks`: the (m,) injection
+    draw for shard ``sid``, keyed ``fold_in(fold_in(key, t), sid)`` so
+    every shard owns a distinct stream that replays identically on the
+    host (``sid`` may be traced — it rides the client_map scan).
+    Malicious rows are the megabatch's FIRST ``c_mal`` rows (the
+    Placement invariant), so corruption draws from honest rows only,
+    exactly like the flat draw."""
+    kt = jax.random.fold_in(jax.random.fold_in(key, t), sid)
+    k_drop, k_stale, k_corr = jax.random.split(kt, 3)
+    drop = jax.random.uniform(k_drop, (m,)) < faults.dropout
+    stale = (jax.random.uniform(k_stale, (m,)) < faults.straggler) & ~drop
+    stale = stale & (t >= faults.straggler_delay)
+    honest = jnp.arange(m) >= c_mal
+    corrupt = ((jax.random.uniform(k_corr, (m,)) < faults.corrupt)
+               & ~drop & ~stale & honest)
+    return drop, stale, corrupt
+
+
+def domain_alive_row(key, t, num_shards, faults):
+    """(S,) bool domain-liveness at round t — the correlated
+    shard-domain schedule.  Shard s is DEAD iff any death onset fired
+    in the dwell window (t - dwell, t]: onsets draw per ``(round,
+    shard)`` from the ``_DOMAIN_SALT`` sub-stream, and the window scan
+    is a fixed-shape stack over the dwell offsets (negative rounds
+    suppressed), so the schedule is pure in ``(key, t)`` and runs
+    identically traced and eagerly."""
+    if faults.shard_dropout <= 0:
+        return jnp.ones((num_shards,), bool)
+    kd = jax.random.fold_in(key, _DOMAIN_SALT)
+
+    def onset(off):
+        t0 = t - off
+        u = jax.random.uniform(jax.random.fold_in(kd, t0),
+                               (num_shards,))
+        return (u < faults.shard_dropout) & (t0 >= 0)
+
+    offs = jnp.arange(faults.shard_dropout_dwell)
+    return ~jax.vmap(onset)(offs).any(axis=0)
+
+
+def apply_shard_faults(grads, t, sid, key, old_slab, faults, c_mal):
+    """Inject shard ``sid``'s round-t faults into its (m, d) megabatch
+    matrix (the hier scan-step seam; flat mirror: :func:`apply_faults`).
+
+    ``old_slab`` is the shard's stale-ring slice for round ``t - delay``
+    (``None`` when stragglers are off).  Returns ``(faulted, dropped,
+    stats, fresh)`` — ``fresh`` is the PRE-fault f32 matrix destined
+    for the shard's ring slot (what this cohort computed THIS round,
+    surfacing at ``t + delay``), and ``stats`` are per-shard int32
+    scalar counts (client_map stacks them to (S,); the engine sums for
+    the round totals and keeps the per-shard vectors for the event).
+    """
+    m = grads.shape[0]
+    drop, stale, corrupt = shard_fault_masks(key, t, sid, m, c_mal,
+                                             faults)
+    fresh = grads.astype(jnp.float32)
+    if faults.straggler > 0:
+        grads = jnp.where(stale[:, None], old_slab.astype(grads.dtype),
+                          grads)
+    if faults.corrupt > 0:
+        if faults.corrupt_mode == "scale":
+            grads = grads * jnp.where(corrupt, faults.corrupt_scale,
+                                      1.0).astype(grads.dtype)[:, None]
+        else:
+            bad = {"nan": jnp.nan, "inf": jnp.inf}[faults.corrupt_mode]
+            grads = jnp.where(corrupt[:, None],
+                              jnp.asarray(bad, grads.dtype), grads)
+    grads = jnp.where(drop[:, None], jnp.zeros((), grads.dtype), grads)
+    stats = {
+        "injected_dropout": jnp.sum(drop).astype(jnp.int32),
+        "injected_straggler": jnp.sum(stale).astype(jnp.int32),
+        "injected_corrupt": jnp.sum(corrupt).astype(jnp.int32),
+    }
+    return grads, drop, stats, fresh
+
+
+def plan_tier2_actions(shards_alive, tier2_name, f2,
+                       fallback=TIER2_FALLBACK):
+    """The tier-2 watchdog's host-side ladder plan: one action int per
+    round, from that round's surviving-shard count (shards whose
+    ``alive_counts`` entry is > 0).  Extends the PR 17 traffic ladder
+    (core/population.py plan_action — REMASK/FALLBACK/HOLD ordering
+    and the per-defense validity bounds) to tier 2: ``f2`` is the
+    kernel's STATIC corrupted-shard count, checked against the
+    SURVIVING shard count."""
+    import numpy as np
+
+    from attacking_federate_learning_tpu.core.population import (
+        plan_action
+    )
+
+    return np.asarray(
+        [plan_action(tier2_name, fallback, int(s), int(f2), 1)
+         for s in shards_alive], np.int32)
+
+
+def hier_fault_schedule(key, t0, count, placement, faults):
+    """Host replay of the hier fault schedule for rounds [t0,
+    t0+count): the ground truth a faulted hierarchical run's emitted
+    'fault' events are diffed against (tools/fault_matrix.py) and the
+    input to the tier-2 ladder plan.  Reuses the exact primitive draws
+    the scanned program runs (:func:`shard_fault_masks`,
+    :func:`domain_alive_row`) eagerly, so the counts match
+    bit-for-bit.  Quarantine accounting mirrors the server's
+    visibility: dropped rows plus non-finite corruption ('nan'/'inf');
+    'scale' corruption stays finite and aggregable.
+
+    Returns a list of per-round dicts with the event payload fields
+    (``injected_*``, ``quarantined``, ``shards_dead``, ``shard_alive``
+    per-shard counts) plus ``shards_alive`` — the surviving-shard
+    count the ladder plans on."""
+    import numpy as np
+
+    S, m = placement.num_shards, placement.megabatch
+    rows = []
+    for i in range(int(count)):
+        t = int(t0) + i
+        dom = np.asarray(domain_alive_row(key, t, S, faults))
+        n_drop = n_stale = n_corr = n_quar = 0
+        alive = np.zeros(S, np.int64)
+        for sid in range(S):
+            drop, stale, corrupt = (
+                np.asarray(x) for x in shard_fault_masks(
+                    key, t, sid, m, placement.mal_counts[sid], faults))
+            n_drop += int(drop.sum())
+            n_stale += int(stale.sum())
+            n_corr += int(corrupt.sum())
+            q = drop | (corrupt if faults.corrupt_mode in ("nan", "inf")
+                        else np.zeros_like(corrupt))
+            n_quar += int(q.sum())
+            alive[sid] = int((~q).sum()) * int(dom[sid])
+        rows.append({
+            "round": t,
+            "injected_dropout": n_drop,
+            "injected_straggler": n_stale,
+            "injected_corrupt": n_corr,
+            "quarantined": n_quar,
+            "shards_dead": int(S - dom.sum()),
+            "shard_alive": [int(a) for a in alive],
+            "shards_alive": int((alive > 0).sum()),
+        })
+    return rows

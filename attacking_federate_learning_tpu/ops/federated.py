@@ -146,6 +146,7 @@ class SpmdSchedule(NamedTuple):
     select: np.ndarray                 # (S,) gathered-row index per shard
     parts: int                         # mesh clients-axis size
     padded_shards: int                 # total scheduled rows (>= S)
+    sids: Tuple[np.ndarray, ...] = ()  # per group: (k_g*parts,) shard ids
 
 
 def spmd_schedule(placement: Placement, parts: int) -> SpmdSchedule:
@@ -169,13 +170,14 @@ def spmd_schedule(placement: Placement, parts: int) -> SpmdSchedule:
             f"axis (S={S}, clients axis={parts}): pick --megabatch / "
             f"--mesh-shape so S % clients == 0 — silently replicating "
             f"megabatches across devices would defeat the sharding")
-    grids, counts, per_dev = [], [], []
+    grids, counts, per_dev, sid_rows = [], [], [], []
     for count, sids in placement.groups:
         k = -(-len(sids) // parts)
         padded = list(sids) + [sids[0]] * (k * parts - len(sids))
         grids.append(placement.grid[padded])
         counts.append(count)
         per_dev.append(k)
+        sid_rows.append(np.asarray(padded, np.int32))
     k_sum = sum(per_dev)
     select = np.empty(S, np.int64)
     for gi, (_, sids) in enumerate(placement.groups):
@@ -185,10 +187,12 @@ def spmd_schedule(placement: Placement, parts: int) -> SpmdSchedule:
             select[sid] = q * k_sum + off + j
     return SpmdSchedule(grids=tuple(grids), counts=tuple(counts),
                         select=select, parts=parts,
-                        padded_shards=k_sum * parts)
+                        padded_shards=k_sum * parts,
+                        sids=tuple(sid_rows))
 
 
-def _client_map_spmd(shard_fn, placement: Placement, plan, *args):
+def _client_map_spmd(shard_fn, placement: Placement, plan, *args,
+                     with_sid=False):
     """One true SPMD program for the megabatch axis: a ``shard_map``
     over the mesh ``clients`` axis in which each device runs the
     group scans over ITS megabatch rows only, then one explicit tiled
@@ -207,20 +211,35 @@ def _client_map_spmd(shard_fn, placement: Placement, plan, *args):
 
     sched = spmd_schedule(placement, plan.mesh.shape[CLIENTS])
     grids = tuple(jnp.asarray(g) for g in sched.grids)
+    sid_ops = (tuple(jnp.asarray(s) for s in sched.sids) if with_sid
+               else ())
+    in_specs = (tuple(P(CLIENTS, None) for _ in grids)
+                + tuple(P(CLIENTS) for _ in sid_ops))
 
     @functools.partial(
-        shard_map, mesh=plan.mesh,
-        in_specs=tuple(P(CLIENTS, None) for _ in grids),
+        shard_map, mesh=plan.mesh, in_specs=in_specs,
         out_specs=P(), check_rep=False)
-    def run(*dev_grids):
+    def run(*operands):
+        dev_grids = operands[:len(grids)]
+        dev_sids = operands[len(grids):]
         pieces = []
-        for count, grid in zip(sched.counts, dev_grids):
+        for gi, (count, grid) in enumerate(zip(sched.counts,
+                                               dev_grids)):
+            if with_sid:
+                # shard ids ride the scan beside the id grid so the
+                # per-shard fault stream replays exactly (ISSUE 19)
+                def body(carry, x, _c=count):
+                    sid, ids = x
+                    return carry, shard_fn(sid, ids, _c, *args)
 
-            def body(carry, ids, _c=count):
-                return carry, shard_fn(ids, _c, *args)
+                xs = (dev_sids[gi], grid)
+            else:
+                def body(carry, ids, _c=count):
+                    return carry, shard_fn(ids, _c, *args)
 
+                xs = grid
             _, stacked = lax.scan(
-                body, _pvary(jnp.zeros((), jnp.int32), CLIENTS), grid)
+                body, _pvary(jnp.zeros((), jnp.int32), CLIENTS), xs)
             pieces.append(stacked)
         local = (pieces[0] if len(pieces) == 1
                  else jax.tree_util.tree_map(
@@ -228,7 +247,7 @@ def _client_map_spmd(shard_fn, placement: Placement, plan, *args):
         return jax.tree_util.tree_map(
             lambda x: lax.all_gather(x, CLIENTS, tiled=True), local)
 
-    out = run(*grids)
+    out = run(*grids, *sid_ops)
     sel = jnp.asarray(sched.select)
     return jax.tree_util.tree_map(lambda a: a[sel], out)
 
@@ -246,7 +265,8 @@ def broadcast(value, plan=None):
     return lax.with_sharding_constraint(value, plan.sharding(P()))
 
 
-def client_map(shard_fn, placement: Placement, *args, plan=None):
+def client_map(shard_fn, placement: Placement, *args, plan=None,
+               with_sid=False):
     """Stream ``shard_fn`` over the client axis, one megabatch at a time.
 
     ``shard_fn(ids, mal_count, *args) -> pytree`` receives a traced
@@ -256,6 +276,13 @@ def client_map(shard_fn, placement: Placement, *args, plan=None):
     shard axis, in megabatch order — the (n/m, ...) shard-estimate
     matrix.  One ``lax.scan`` per placement group (distinct malicious
     count), so only one megabatch's intermediates are live at a time.
+
+    ``with_sid=True`` threads each megabatch's SHARD id through the
+    scan — ``shard_fn(sid, ids, mal_count, *args)`` — so a per-shard
+    PRNG stream (the ISSUE 19 fault draw, keyed ``fold_in(fold_in(key,
+    t), sid)``) replays identically on the host regardless of group
+    order or SPMD padding.  Off by default: the False path traces the
+    exact pre-ISSUE-19 program (HLO byte-identity of faults-off runs).
 
     ``plan``: a MeshPlan whose ``clients`` axis holds > 1 device
     switches to the SPMD mapping (:func:`_client_map_spmd`) — devices
@@ -267,15 +294,24 @@ def client_map(shard_fn, placement: Placement, *args, plan=None):
         from attacking_federate_learning_tpu.parallel.mesh import CLIENTS
 
         if plan.mesh.shape[CLIENTS] > 1:
-            return _client_map_spmd(shard_fn, placement, plan, *args)
+            return _client_map_spmd(shard_fn, placement, plan, *args,
+                                    with_sid=with_sid)
     pieces, order = [], []
     for count, sids in placement.groups:
         grid = jnp.asarray(placement.grid[list(sids)])
 
-        def body(carry, ids, _c=count):
-            return carry, shard_fn(ids, _c, *args)
+        if with_sid:
+            def body(carry, x, _c=count):
+                sid, ids = x
+                return carry, shard_fn(sid, ids, _c, *args)
 
-        _, stacked = lax.scan(body, jnp.zeros((), jnp.int32), grid)
+            xs = (jnp.asarray(list(sids), jnp.int32), grid)
+        else:
+            def body(carry, ids, _c=count):
+                return carry, shard_fn(ids, _c, *args)
+
+            xs = grid
+        _, stacked = lax.scan(body, jnp.zeros((), jnp.int32), xs)
         pieces.append(stacked)
         order.extend(sids)
     out = (pieces[0] if len(pieces) == 1

@@ -237,13 +237,21 @@ def secagg_cohort(grads, alive, key, t, ids=None):
         return unmask_sum(wire, deltas, grads, alive, key_t, ids)
 
 
-def secagg_group(grads, key, t, ids):
-    """Groupwise mode's per-megabatch protocol round (everyone in the
-    group submits — faults do not compose with hierarchical rounds
-    yet): masks keyed on GLOBAL client ids, recovery trivial.  Returns
-    ``(recovered, sum_check_ok int32)``."""
-    recovered, stats = secagg_cohort(grads, None, key, t, ids=ids)
-    return recovered, stats["secagg_sum_check_ok"]
+def secagg_group(grads, key, t, ids, alive=None):
+    """Groupwise mode's per-megabatch protocol round: masks keyed on
+    GLOBAL client ids.  With everyone submitting (``alive=None``)
+    recovery is trivial and the return is the compact
+    ``(recovered, sum_check_ok int32)`` pair — byte-identical to the
+    pre-fault program.  ``alive`` (m,) bool is the hier fault
+    harness's per-group dropout mask (ISSUE 19): the dropped members'
+    pair masks are reconstructed over the group's global client ids
+    (:func:`recovery_residue` — the per-group Bonawitz seed-reveal)
+    and the full ``secagg_*`` stats pytree rides out instead:
+    ``(recovered, stats)``."""
+    if alive is None:
+        recovered, stats = secagg_cohort(grads, None, key, t, ids=ids)
+        return recovered, stats["secagg_sum_check_ok"]
+    return secagg_cohort(grads, alive, key, t, ids=ids)
 
 
 def group_envelope_stats(group_means, megabatch):

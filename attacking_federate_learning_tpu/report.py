@@ -265,10 +265,27 @@ def fault_recovery(events):
     """Fault/recovery accounting from 'fault' events (core/faults.py +
     the engine watchdog): total injected per kind, quarantined rows,
     rounds touched, and every rollback record.  Returns None when the
-    run emitted no fault events (faults off)."""
+    run emitted no fault events (faults off).
+
+    Hierarchical (schema v13) events are shard-qualified — one event
+    per ROUND whose scalar counts already sum over shards, with the
+    per-shard survivor vector riding along as ``shard_alive`` — so the
+    per-round accumulation above needs no change (summing the vector
+    AND the scalars would double count; only the scalars are summed).
+    The shard-domain axis gets its own rollup: rounds with at least
+    one dead domain, total domain deaths, the minimum surviving-shard
+    count, and the tier-2 ladder action histogram
+    (remask/fallback/hold, core/population.py ACTION_NAMES)."""
+    from attacking_federate_learning_tpu.core.population import (
+        ACTION_NAMES
+    )
+
     injected = Counter()
     quarantined = rounds = 0
     rollbacks = []
+    dead_rounds = shards_dead_total = 0
+    min_alive = None
+    actions = Counter()
     for e in events:
         if e.get("kind") != "fault":
             continue
@@ -282,10 +299,28 @@ def fault_recovery(events):
         for k, v in e.items():
             if k.startswith("injected_"):
                 injected[k[len("injected_"):]] += int(v)
+        if "shards_dead" in e:
+            dead = int(e["shards_dead"])
+            shards_dead_total += dead
+            dead_rounds += dead > 0
+            alive = int(e.get("shards_alive", 0))
+            min_alive = (alive if min_alive is None
+                         else min(min_alive, alive))
+        if "tier2_action" in e:
+            act = int(e["tier2_action"])
+            actions[ACTION_NAMES[act] if 0 <= act < len(ACTION_NAMES)
+                    else str(act)] += 1
     if not rounds and not rollbacks:
         return None
-    return {"rounds": rounds, "injected": dict(injected),
-            "quarantined": quarantined, "rollbacks": rollbacks}
+    out = {"rounds": rounds, "injected": dict(injected),
+           "quarantined": quarantined, "rollbacks": rollbacks}
+    if min_alive is not None:
+        out["shard_domains"] = {
+            "dead_rounds": dead_rounds,
+            "shards_dead_total": shards_dead_total,
+            "min_shards_alive": min_alive,
+            "tier2_actions": dict(actions)}
+    return out
 
 
 def async_summary(events):
@@ -577,6 +612,14 @@ def _print_run(path, s, out):
             flt["injected"].items())) or "none"
         out(f"  faults over {flt['rounds']} rounds: injected [{inj}]  "
             f"quarantined {flt['quarantined']}")
+        sd = flt.get("shard_domains")
+        if sd:
+            acts = "  ".join(f"{k}:{v}" for k, v in sorted(
+                sd["tier2_actions"].items())) or "none"
+            out(f"    shard domains: {sd['dead_rounds']} round(s) with "
+                f"a dead domain ({sd['shards_dead_total']} shard-round "
+                f"deaths), min shards alive "
+                f"{sd['min_shards_alive']}  tier-2 ladder [{acts}]")
         for rb in flt["rollbacks"]:
             out(f"    rollback at round {rb['round']} -> restored round "
                 f"{rb['restored_round']} (total {rb['rollbacks_total']})")

@@ -80,7 +80,13 @@ selection threshold, trim-boundary distances and kept-coordinate
 fractions, Bulyan per-iteration selection slack) rolled up host-side
 into the colluder-survival ledger (colluder_margin /
 colluder_selected / colluder_kept_mass), with the attack's envelope
-utilization and traffic's f_eff riding along.
+utilization and traffic's f_eff riding along; v13 extends ``fault``
+with the hierarchical shard-domain fields (core/faults.py ISSUE 19:
+``shard_alive`` — the per-shard survivor-count vector after quarantine
+and domain death, ``shards_dead`` / ``shards_alive`` — the correlated
+shard-DOMAIN accounting, and ``tier2_action`` — the host-planned
+remask/fallback/hold ladder decision at tier-2), all host-replayable
+from the fault key (tools/fault_matrix.py diffs them exactly).
 Readers accept every version; older logs simply never carry the newer
 kinds, and a newer-only kind stamped with an older version is an
 emitter bug, rejected (``KIND_MIN_VERSION``).
@@ -98,8 +104,8 @@ from typing import Optional
 import numpy as np
 
 
-SCHEMA_VERSION = 12
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12)
+SCHEMA_VERSION = 13
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13)
 
 # kind -> required fields.  Producers: core/engine.py (round, eval, asr,
 # profile, stream, defense, attack, selection_hist via RunLogger).

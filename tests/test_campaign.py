@@ -137,8 +137,16 @@ _INVALID = [
      "nothing per-client OR per-group"),
     (dict(aggregation="hierarchical", megabatch=5, users_count=12),
      "auto", "must divide users_count"),
-    (dict(aggregation="hierarchical", megabatch=4,
-          faults=dict(dropout=0.2)), "auto", "fault"),
+    # ISSUE 19: hierarchical ⊕ faults is now a VALID composition; the
+    # rejections that remain are the real structural ones — correlated
+    # shard-domain death needs shard domains to kill, and the straggler
+    # ring buffer is a cross-round carry the SPMD client_map can't
+    # thread.
+    (dict(faults=dict(shard_dropout=0.3), defense="Median"), "auto",
+     "shard-DOMAIN"),
+    (dict(aggregation="hierarchical", megabatch=4, users_count=32,
+          mesh_shape=[8, 1], faults=dict(straggler=0.1),
+          defense="TrimmedMean"), "auto", "SPMD client_map"),
     (dict(aggregation="hierarchical", megabatch=4,
           defense="GeoMedian"), "auto", "tier-1 defense"),
     (dict(aggregation="async", async_buffer=0), "auto",
@@ -176,8 +184,7 @@ def test_precheck_agrees_with_real_construction(tmp_path):
 
     cases = [
         dict(defense="Bulyan", users_count=10, mal_prop=0.24),
-        dict(aggregation="hierarchical", megabatch=4,
-             faults=dict(dropout=0.2)),
+        dict(faults=dict(shard_dropout=0.3), defense="Median"),
         dict(aggregation="async", async_buffer=20, users_count=12,
              mal_prop=0.25),
     ]
@@ -531,6 +538,12 @@ def test_cfg_to_cli_args_round_trip(tmp_path):
         _base(tmp_path, faults=dict(dropout=0.1, corrupt=0.05,
                                     corrupt_mode="scale"),
               defense="Median", checkpoint_every=2),
+        # ISSUE 19: faults ⊕ hierarchical round-trips, shard-domain
+        # flags included.
+        _base(tmp_path, aggregation="hierarchical", megabatch=4,
+              defense="TrimmedMean",
+              faults=dict(dropout=0.1, shard_dropout=0.25,
+                          shard_dropout_dwell=2)),
         _base(tmp_path, secagg="vanilla", defense="NoDefense",
               backdoor="pattern"),
     ]

@@ -39,7 +39,11 @@ ladder action, replayable on host via replay_traffic — plus v12's
 'margin' kind: one robustness-margin record per round under --margins
 runs, core/engine.py + utils/margins.py — per-row defense decision
 margins, the colluder-survival rollups and the attack-side envelope
-utilization).  An
+utilization — plus v13's hierarchical shard-domain 'fault' fields:
+the per-shard survivor-count vector (shard_alive), the correlated
+shard-DOMAIN accounting (shards_dead / shards_alive) and the
+host-planned tier-2 ladder decision (tier2_action), all replayable
+from the fault key via core/faults.py:hier_fault_schedule).  An
 event stamped with a
 version this reader does not know is reported as "produced by a newer
 writer" — a clear per-line error, never a KeyError — and a newer-only

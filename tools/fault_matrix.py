@@ -14,9 +14,17 @@ then closes the loop three ways:
    touching the engine) — an emitted count that drifts from the
    schedule fails the sweep.
 
+Two composition legs ride along: the dropout x async-buffer cell
+(core/async_rounds.py) and the hierarchical shard-domain chaos cells
+(ISSUE 19) — two-tier runs under per-client faults PLUS correlated
+shard-DOMAIN death, whose per-round 'fault' events (per-shard survivor
+vectors included) and tier-2 ladder actions are diffed against the
+host replay (core/faults.py:hier_fault_schedule / plan_tier2_actions).
+
 Usage:
     python tools/fault_matrix.py                        # full smoke
     python tools/fault_matrix.py --epochs 5 --defenses Krum,Median
+    python tools/fault_matrix.py --no-async --no-hier   # flat only
 
 Exit status 0 when every cell passes, 1 otherwise.  CI-wired via
 tests/test_faults.py next to the check_events hook.
@@ -206,11 +214,120 @@ def run_async_cell(defense, epochs, users, log_dir, dropout=0.2,
     return errors
 
 
+# Hierarchical chaos cells (ISSUE 19): (defense, users, megabatch)
+# triples sized so BOTH tiers clear their validity bounds at the
+# spread-placement per-tier f (Krum needs n >= 2f+3 at each tier,
+# Bulyan n >= 4f+3 — ops/federated.py tier1_assumed/tier2_assumed).
+# The first cell adds stragglers: the (delay, S, m, d) ring only
+# exists under the sequential scan, and the sweep should cover it.
+HIER_CELLS = (
+    ("TrimmedMean", 16, 4, True),
+    ("Median", 16, 4, False),
+    ("NoDefense", 16, 4, False),
+    ("Krum", 25, 5, False),
+    ("Bulyan", 49, 7, False),
+)
+
+
+def run_hier_cell(defense, epochs, users, megabatch, log_dir,
+                  dropout=0.2, corrupt=0.05, shard_dropout=0.25,
+                  with_straggler=False):
+    """ISSUE 19 satellite: the hierarchical chaos leg.  One short
+    aggregation='hierarchical' run under per-client faults AND the
+    correlated shard-DOMAIN axis, then three closures: the run
+    completes (graceful degradation through the tier-2 ladder), the
+    log schema-validates, and every per-round 'fault' event — the
+    per-shard survivor vector ``shard_alive`` included — matches the
+    host replay (core/faults.py:hier_fault_schedule is pure in
+    (fault key, round, shard id)), with the emitted ``tier2_action``
+    diffed against the independently recomputed ladder plan
+    (plan_tier2_actions).  Returns a list of error strings."""
+    import importlib.util
+
+    from attacking_federate_learning_tpu import config as C
+    from attacking_federate_learning_tpu.attacks import DriftAttack
+    from attacking_federate_learning_tpu.config import (
+        ExperimentConfig, FaultConfig
+    )
+    from attacking_federate_learning_tpu.core.engine import (
+        FederatedExperiment
+    )
+    from attacking_federate_learning_tpu.core.faults import (
+        hier_fault_schedule, plan_tier2_actions
+    )
+    from attacking_federate_learning_tpu.data.datasets import load_dataset
+    from attacking_federate_learning_tpu.utils.metrics import (
+        RunLogger, iter_events
+    )
+
+    faults = FaultConfig(
+        dropout=dropout, corrupt=corrupt, shard_dropout=shard_dropout,
+        shard_dropout_dwell=2,
+        straggler=0.1 if with_straggler else 0.0, straggler_delay=2)
+    cfg = ExperimentConfig(
+        dataset=C.SYNTH_MNIST, users_count=users,
+        mal_prop=0.2 if defense != "Bulyan" else 1.0 / megabatch,
+        batch_size=16, epochs=epochs, test_step=epochs,
+        defense=defense, synth_train=256, synth_test=64,
+        aggregation="hierarchical", megabatch=megabatch,
+        faults=faults, log_dir=log_dir)
+    ds = load_dataset(cfg.dataset, seed=0, synth_train=256, synth_test=64)
+    exp = FederatedExperiment(cfg, attacker=DriftAttack(1.0), dataset=ds)
+    name = f"fault_matrix_hier_{defense}"
+    path = os.path.join(log_dir, name + ".jsonl")
+    try:
+        with RunLogger(cfg, None, log_dir, jsonl_name=name) as logger:
+            exp.run(logger)
+    except Exception as e:                        # noqa: BLE001
+        return [f"raised: {e}"]
+
+    spec = importlib.util.spec_from_file_location(
+        "check_events", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "check_events.py"))
+    ce = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ce)
+    errors = []
+    _, _, bad_lines = ce.check_file(path)
+    errors += [f"line {ln}: {msg}" for ln, msg in bad_lines]
+
+    events = [e for e in iter_events(path)
+              if e["kind"] == "fault" and not e.get("rolled_back")]
+    if len(events) != epochs:
+        errors.append(f"expected {epochs} fault events, got "
+                      f"{len(events)}")
+        return errors
+    rows = hier_fault_schedule(exp._fault_key, 0, epochs,
+                               exp._placement, exp.faults)
+    plan = plan_tier2_actions([r["shards_alive"] for r in rows],
+                              exp._tier2_name, exp._tier2_f)
+    for got, want, act in zip(sorted(events, key=lambda e: e["round"]),
+                              rows, plan):
+        t = want["round"]
+        for k in ("injected_dropout", "injected_straggler",
+                  "injected_corrupt", "quarantined", "shards_dead",
+                  "shards_alive"):
+            if int(got.get(k, -1)) != want[k]:
+                errors.append(f"round {t}: {k} emitted {got.get(k)} "
+                              f"!= scheduled {want[k]}")
+        if [int(x) for x in got.get("shard_alive", [])] != \
+                want["shard_alive"]:
+            errors.append(f"round {t}: shard_alive emitted "
+                          f"{got.get('shard_alive')} != scheduled "
+                          f"{want['shard_alive']}")
+        if int(got.get("tier2_action", -1)) != int(act):
+            errors.append(f"round {t}: tier2_action emitted "
+                          f"{got.get('tier2_action')} != planned "
+                          f"{int(act)}")
+    return errors
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="5-round fault x defense smoke sweep with schedule "
                     "validation (core/faults.py), plus the dropout x "
-                    "async-buffer leg (core/async_rounds.py).")
+                    "async-buffer leg (core/async_rounds.py) and the "
+                    "hierarchical shard-domain chaos leg "
+                    "(core/faults.py:hier_fault_schedule).")
     p.add_argument("--epochs", type=int, default=5)
     p.add_argument("--users", type=int, default=15)
     p.add_argument("--defenses", default=",".join(MASK_AWARE_DEFENSES),
@@ -221,6 +338,11 @@ def main(argv=None) -> int:
     p.add_argument("--corrupt", type=float, default=0.05)
     p.add_argument("--no-async", action="store_true",
                    help="skip the dropout x async-buffer smoke leg")
+    p.add_argument("--no-hier", action="store_true",
+                   help="skip the hierarchical shard-domain chaos leg")
+    p.add_argument("--hier-shard-dropout", type=float, default=0.25,
+                   help="per-round shard-DOMAIN failure onset "
+                        "probability for the hier leg")
     p.add_argument("--log-dir", default=None,
                    help="where run JSONLs land (default: a temp dir)")
     args = p.parse_args(argv)
@@ -271,6 +393,27 @@ def main(argv=None) -> int:
             print(f"ok   async(Krum): {args.epochs} rounds, dropout x "
                   f"async-buffer — async + fault events match the "
                   f"replayed schedule")
+    if not args.no_hier:
+        wanted = {d.strip() for d in args.defenses.split(",")}
+        for defense, users, megabatch, stragglers in HIER_CELLS:
+            if defense not in wanted:
+                continue
+            errors = run_hier_cell(
+                defense, args.epochs, users, megabatch, log_dir,
+                dropout=args.dropout, corrupt=args.corrupt,
+                shard_dropout=args.hier_shard_dropout,
+                with_straggler=stragglers)
+            tag = (f"hier({defense}, n={users}, m={megabatch}"
+                   f"{', stragglers' if stragglers else ''})")
+            if errors:
+                failed = True
+                print(f"FAIL {tag}: {len(errors)} problem(s)")
+                for e in errors[:10]:
+                    print(f"  {e}")
+            else:
+                print(f"ok   {tag}: {args.epochs} rounds, shard-domain "
+                      f"chaos — per-shard fault events + tier-2 ladder "
+                      f"actions match the host replay")
     return 1 if failed else 0
 
 

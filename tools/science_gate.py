@@ -164,6 +164,23 @@ CELLS = {
                                  margins=True, rounds=30,
                                  partition="femnist_style",
                                  style_strength=0.5),
+    # --- PR 19: shard-domain faults in the hierarchical tree (ISSUE
+    # 19, core/faults.py).  The behavioral constants under correlated
+    # shard death: n=20/m=4 gives S=5 shards — exactly tier-2 Krum's
+    # 2f+3 validity floor at f2=1, so every dead domain under-fills the
+    # bound and the round walks the remask/fallback/hold ladder.  The
+    # schedule facts (dead-domain rounds, shard-round deaths,
+    # quarantine total, per-rung ladder counts) replay exactly — the
+    # schedule is pure in (fault key, t) — band 0; the accuracy is
+    # Krum-selection-mediated over a changing shard cohort, banded
+    # like the other krum cells.
+    "hier_krum_shard_dropout": dict(defense="Krum", z=1.5, n=20,
+                                    mal_prop=0.2,
+                                    aggregation="hierarchical",
+                                    megabatch=4,
+                                    faults=dict(dropout=0.1,
+                                                shard_dropout=0.2,
+                                                shard_dropout_dwell=2)),
 }
 
 # Per-metric tolerance bands (absolute; 0 = exact).  Authored here,
@@ -216,6 +233,12 @@ CELL_BANDS = {
     # mechanism, now over per-round sampled rows); the schedule facts
     # are exact host replays (band 0 via the metric defaults).
     "traffic_krum_churn": {"final_accuracy": 3.0, "max_accuracy": 3.0},
+    # Faulted-hierarchy Krum: accuracy is selection-mediated at BOTH
+    # tiers (per-shard Krum over a quarantined cohort, tier-2 over the
+    # survivors); the shard-domain schedule facts are exact host
+    # replays (band 0 via the metric defaults).
+    "hier_krum_shard_dropout": {"final_accuracy": 3.0,
+                                "max_accuracy": 3.0},
     # Margin cells: every metric reads the f32 distance scores the
     # selections rest on, so all carry selection-mediated bands; the
     # DISCRIMINATORS (margin_tie_rounds 28 vs 19, band 3/4;
@@ -298,7 +321,9 @@ def measure_cell(name: str, spec: dict, rounds: int = ROUNDS) -> dict:
         async_max_staleness=spec.get("async_max_staleness", 2),
         staleness_weight=spec.get("staleness_weight", "none"),
         traffic=(C.TrafficConfig(**spec["traffic"])
-                 if "traffic" in spec else None))
+                 if "traffic" in spec else None),
+        faults=(C.FaultConfig(**spec["faults"])
+                if "faults" in spec else None))
     ds = load_dataset(cfg.dataset, seed=0, synth_train=cfg.synth_train,
                       synth_test=cfg.synth_test)
     if backdoor:
@@ -362,6 +387,27 @@ def measure_cell(name: str, spec: dict, rounds: int = ROUNDS) -> dict:
             sum(e["arrived"] for e in tev) / len(tev), 4)
         out["degraded_rounds"] = sum(
             1 for e in tev if e["action"] != "remask")
+    if cfg.faults is not None and hier:
+        # Shard-domain schedule facts from the host replay (pure in
+        # the fault key + t): dead-domain incidence, quarantine mass,
+        # and the tier-2 ladder's per-rung round counts.
+        from attacking_federate_learning_tpu.core.faults import (
+            hier_fault_schedule, plan_tier2_actions
+        )
+        from attacking_federate_learning_tpu.core.population import (
+            ACTION_NAMES
+        )
+
+        rows = hier_fault_schedule(exp._fault_key, 0, rounds,
+                                   exp._placement, exp.faults)
+        acts = plan_tier2_actions([r["shards_alive"] for r in rows],
+                                  exp._tier2_name, exp._tier2_f)
+        out["dead_domain_rounds"] = sum(
+            1 for r in rows if r["shards_dead"] > 0)
+        out["shard_deaths_total"] = sum(r["shards_dead"] for r in rows)
+        out["quarantined_total"] = sum(r["quarantined"] for r in rows)
+        for i, rung in enumerate(ACTION_NAMES):
+            out[f"tier2_{rung}_rounds"] = int(np.sum(acts == i))
     if shard_events:
         from attacking_federate_learning_tpu.report import (
             forensics_summary

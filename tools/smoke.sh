@@ -84,7 +84,15 @@
 #      utils/margins.py), check_events --stats over the private logs
 #      (v12 kind + per-kind histogram), a margin-event audit (one per
 #      round, rollup fields present), 'runs margins <id>' exit-0 on
-#      one run, and the cross-run drift render over both.
+#      one run, and the cross-run drift render over both;
+#  15. faulted-hierarchy smoke (ISSUE 19) — a journaled 6-round
+#      hierarchical TrimmedMean run under per-client dropout/corrupt
+#      PLUS correlated shard-DOMAIN death (--fault-shard-dropout),
+#      check_events --stats over its private log (schema-v13 'fault'
+#      events with per-shard survivor vectors), a host-replay audit
+#      (emitted events must equal core/faults.py:hier_fault_schedule
+#      exactly, tier-2 ladder action included), and 'report' exit-0
+#      with the shard-domain fault table rendered.
 #
 # Exit: nonzero if any leg fails.  Always CPU (the gates' baselines are
 # CPU artifacts, and the matrices must not touch a TPU capture).
@@ -99,33 +107,33 @@ fail=0
 shopt -s nullglob
 jsonls=(logs/*.jsonl)
 if [ ${#jsonls[@]} -gt 0 ]; then
-    echo "== smoke 1/14: check_events (${#jsonls[@]} logs) =="
+    echo "== smoke 1/15: check_events (${#jsonls[@]} logs) =="
     python tools/check_events.py "${jsonls[@]}" || fail=1
 else
-    echo "== smoke 1/14: check_events — no logs/*.jsonl yet, skipped =="
+    echo "== smoke 1/15: check_events — no logs/*.jsonl yet, skipped =="
 fi
 
 crash_work=""
 if [ "${1:-}" != "--fast" ]; then
-    echo "== smoke 2/14: fault_matrix =="
+    echo "== smoke 2/15: fault_matrix =="
     python tools/fault_matrix.py || fail=1
-    echo "== smoke 3/14: crash_matrix (supervised preempt/resume) =="
+    echo "== smoke 3/15: crash_matrix (supervised preempt/resume) =="
     # Keep the matrix's run stores: leg 6 registry-checks them.
     crash_work="$(mktemp -d -t crash_matrix_XXXXXX)"
     python tools/crash_matrix.py --workdir "$crash_work" || fail=1
 else
-    echo "== smoke 2/14: fault_matrix — skipped (--fast) =="
-    echo "== smoke 3/14: crash_matrix — skipped (--fast) =="
+    echo "== smoke 2/15: fault_matrix — skipped (--fast) =="
+    echo "== smoke 3/15: crash_matrix — skipped (--fast) =="
 fi
 
-echo "== smoke 4/14: perf_gate (+ memproof + wireproof + pallasproof"
+echo "== smoke 4/15: perf_gate (+ memproof + wireproof + pallasproof"
 echo "   + shardproof + stageproof) =="
 python tools/perf_gate.py --memproof || fail=1
 
-echo "== smoke 5/14: science_gate (behavioral drift) =="
+echo "== smoke 5/15: science_gate (behavioral drift) =="
 python tools/science_gate.py || fail=1
 
-echo "== smoke 6/14: runs selfcheck (registry) =="
+echo "== smoke 6/15: runs selfcheck (registry) =="
 python -m attacking_federate_learning_tpu.cli runs selfcheck || fail=1
 if [ -n "$crash_work" ]; then
     # The registry over the crash matrix's preempt/resume artifacts:
@@ -142,7 +150,7 @@ if [ -n "$crash_work" ]; then
     rm -rf "$crash_work"
 fi
 
-echo "== smoke 7/14: hierarchical aggregation (journaled, audited) =="
+echo "== smoke 7/15: hierarchical aggregation (journaled, audited) =="
 hier_work="$(mktemp -d -t hier_smoke_XXXXXX)"
 for def in Krum TrimmedMean; do
     python -m attacking_federate_learning_tpu.cli \
@@ -168,7 +176,7 @@ sys.exit(bad)
 PY
 rm -rf "$hier_work"
 
-echo "== smoke 8/14: secure aggregation (journaled, audited) =="
+echo "== smoke 8/15: secure aggregation (journaled, audited) =="
 sa_work="$(mktemp -d -t secagg_smoke_XXXXXX)"
 # vanilla: one dropout-rate high enough that the 5-round seeded run is
 # guaranteed (and pinned by the audit below) to include at least one
@@ -217,7 +225,7 @@ sys.exit(bad)
 PY
 rm -rf "$sa_work"
 
-echo "== smoke 9/14: hierarchical telemetry + forensics (journaled) =="
+echo "== smoke 9/15: hierarchical telemetry + forensics (journaled) =="
 fx_work="$(mktemp -d -t hier_tele_smoke_XXXXXX)"
 # 5-round journaled hierarchical x Krum run with --telemetry: the run
 # must emit one schema-v6 'shard_selection' event per round.
@@ -254,7 +262,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     trace hier_tele_smoke -o "$fx_work/trace.json" || fail=1
 rm -rf "$fx_work"
 
-echo "== smoke 10/14: asynchronous rounds (journaled, audited) =="
+echo "== smoke 10/15: asynchronous rounds (journaled, audited) =="
 as_work="$(mktemp -d -t async_smoke_XXXXXX)"
 # 5-round journaled FedBuff runs: k=8 of n=12 aggregated per applied
 # round, staleness bound 2, poly weighting, Krum + TrimmedMean.
@@ -304,7 +312,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     async async_Krum_smoke || fail=1
 rm -rf "$as_work"
 
-echo "== smoke 11/14: campaign engine (kill + resume, audited) =="
+echo "== smoke 11/15: campaign engine (kill + resume, audited) =="
 ce_work="$(mktemp -d -t campaign_smoke_XXXXXX)"
 cat > "$ce_work/spec.json" <<SPEC
 {"name": "smoke",
@@ -356,7 +364,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     campaign "$camp_id" || fail=1
 rm -rf "$ce_work"
 
-echo "== smoke 12/14: measured walls (profiled run + wall gate) =="
+echo "== smoke 12/15: measured walls (profiled run + wall gate) =="
 wl_work="$(mktemp -d -t walls_smoke_XXXXXX)"
 # 5-round journaled flat x Krum with every eval interval profiled: the
 # engine books each span capture onto the stage taxonomy and emits
@@ -402,7 +410,7 @@ python tools/wall_gate.py --update --baseline "$wl_work/WALL_BASELINE.json" \
 python tools/wall_gate.py --baseline "$wl_work/WALL_BASELINE.json" || fail=1
 rm -rf "$wl_work"
 
-echo "== smoke 13/14: population traffic (churn, ladder, audited) =="
+echo "== smoke 13/15: population traffic (churn, ladder, audited) =="
 tr_work="$(mktemp -d -t traffic_smoke_XXXXXX)"
 # 10-round journaled churn run from an unreliable 16-client population:
 # the sampled cohort routinely misses Krum's 2f+3 validity bound, so
@@ -462,7 +470,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     traffic traffic_smoke || fail=1
 rm -rf "$tr_work"
 
-echo "== smoke 14/14: robustness margins (v12 audit + drift render) =="
+echo "== smoke 14/15: robustness margins (v12 audit + drift render) =="
 mg_work="$(mktemp -d -t margins_smoke_XXXXXX)"
 # Two short journaled Bulyan --margins runs at different seeds: the
 # in-jit margin observatory emits one schema-v12 'margin' event per
@@ -511,6 +519,89 @@ python -m attacking_federate_learning_tpu.cli runs \
     --run-dir "$mg_work/runs" --bench '' --progress '' \
     margins margins_smoke_0 margins_smoke_1 || fail=1
 rm -rf "$mg_work"
+
+echo "== smoke 15/15: faulted hierarchy (shard domains, journaled) =="
+fh_work="$(mktemp -d -t fault_hier_smoke_XXXXXX)"
+# A journaled 6-round two-tier run under BOTH fault granularities:
+# per-client dropout/corrupt inside each megabatch plus correlated
+# shard-DOMAIN death; the shard-dropout rate is high enough that the
+# seeded run includes dead-domain rounds (pinned by the audit below).
+python -m attacking_federate_learning_tpu.cli \
+    -d TrimmedMean -s SYNTH_MNIST -n 16 -m 0.25 -c 16 -e 6 \
+    --synth-train 256 --synth-test 64 --seed 3 \
+    --aggregation hierarchical --megabatch 4 \
+    --fault-dropout 0.2 --fault-corrupt 0.1 \
+    --fault-shard-dropout 0.3 --fault-shard-dropout-dwell 2 \
+    --journal --run-id fault_hier_smoke --no-checkpoint \
+    --log-dir "$fh_work/logs" --run-dir "$fh_work/runs" \
+    > /dev/null || fail=1
+# The private log validates (schema-v13 'fault' events with per-shard
+# survivor vectors) and the --stats histogram renders.
+python tools/check_events.py --stats \
+    "$fh_work/logs/fault_hier_smoke.jsonl" || fail=1
+# Host-replay audit: every emitted 'fault' event — per-shard
+# shard_alive vector and tier-2 ladder action included — must equal
+# the independent regeneration from the fault key.
+python - "$fh_work" <<'PY' || fail=1
+import json, os, sys
+from attacking_federate_learning_tpu import config as C
+from attacking_federate_learning_tpu.core.faults import (
+    fault_key, hier_fault_schedule, plan_tier2_actions
+)
+from attacking_federate_learning_tpu.ops.federated import (
+    make_placement, tier2_assumed
+)
+from attacking_federate_learning_tpu.utils.lifecycle import RunJournal
+
+work = sys.argv[1]
+problems = RunJournal(os.path.join(work, "runs"),
+                      "fault_hier_smoke").verify(epochs=6, test_step=5)
+cfg = C.ExperimentConfig(
+    dataset=C.SYNTH_MNIST, users_count=16, mal_prop=0.25, seed=3,
+    aggregation="hierarchical", megabatch=4, defense="TrimmedMean",
+    faults=C.FaultConfig(dropout=0.2, corrupt=0.1, shard_dropout=0.3,
+                         shard_dropout_dwell=2))
+place = make_placement(cfg.users_count, cfg.corrupted_count,
+                       cfg.megabatch, cfg.mal_placement)
+rows = hier_fault_schedule(fault_key(cfg), 0, 6, place, cfg.faults)
+plan = plan_tier2_actions(
+    [r["shards_alive"] for r in rows], cfg.defense,
+    tier2_assumed(cfg.corrupted_count, cfg.megabatch))
+events = [json.loads(line) for line in
+          open(os.path.join(work, "logs", "fault_hier_smoke.jsonl"))]
+flt = sorted((e for e in events if e.get("kind") == "fault"
+              and not e.get("rolled_back")),
+             key=lambda e: e["round"])
+if len(flt) != 6:
+    problems.append(f"{len(flt)} fault events, want one per round")
+else:
+    for got, want, act in zip(flt, rows, plan):
+        for k in ("injected_dropout", "injected_corrupt", "quarantined",
+                  "shards_dead", "shards_alive"):
+            if int(got.get(k, -1)) != want[k]:
+                problems.append(
+                    f"round {want['round']}: {k} {got.get(k)} != "
+                    f"replayed {want[k]}")
+        if [int(x) for x in got.get("shard_alive", [])] != \
+                want["shard_alive"]:
+            problems.append(f"round {want['round']}: shard_alive "
+                            f"{got.get('shard_alive')} != "
+                            f"{want['shard_alive']}")
+        if int(got.get("tier2_action", -1)) != int(act):
+            problems.append(f"round {want['round']}: tier2_action "
+                            f"{got.get('tier2_action')} != {int(act)}")
+    if not any(r["shards_dead"] > 0 for r in rows):
+        problems.append("no dead-domain round fired (raise "
+                        "--fault-shard-dropout)")
+status = "ok" if not problems else f"FAIL {problems}"
+print(f"  fault_hier_smoke: {len(flt)} fault events, host replay "
+      f"exact ({status})")
+sys.exit(bool(problems))
+PY
+# 'report' must render the shard-domain fault table (exit 0).
+python -m attacking_federate_learning_tpu.cli report \
+    "$fh_work/logs/fault_hier_smoke.jsonl" || fail=1
+rm -rf "$fh_work"
 
 if [ $fail -ne 0 ]; then
     echo "SMOKE FAILED"
