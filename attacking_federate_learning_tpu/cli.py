@@ -453,6 +453,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "margins').  Requires a margin-bearing defense "
                         "(Krum/TrimmedMean/Median/Bulyan) on an "
                         "on-device impl")
+    p.add_argument("--numerics", action="store_true",
+                   help="numerics & determinism observatory "
+                        "(utils/numerics.py): in-jit numeric health "
+                        "counters — per-stage nonfinite counts, "
+                        "gradient-norm dynamic range, distance-Gram "
+                        "cancellation depth, and tie-proximity counters "
+                        "banded at k ulp of the margin decision "
+                        "boundaries — one schema-v14 'numerics' event "
+                        "per round (read with 'runs numerics'; "
+                        "cross-impl envelopes in NUMERICS_BASELINE.json)."
+                        "  Works with any defense; tie/cancellation "
+                        "counters need a margin-bearing one on an "
+                        "on-device impl")
     p.add_argument("--trace-dir", type=str, default=None,
                    help="capture a jax.profiler XLA trace into this dir")
     p.add_argument("--profile-every", default=0, type=int, metavar="K",
@@ -561,6 +574,7 @@ def config_from_args(args) -> ExperimentConfig:
         log_round_stats=args.round_stats,
         telemetry=args.telemetry,
         margins=args.margins,
+        numerics=args.numerics,
         synth_train=args.synth_train,
         synth_test=args.synth_test,
         data_augment={"auto": None, "on": True, "off": False}[args.augment],

@@ -586,6 +586,20 @@ class ExperimentConfig:
     # are read from.  Off by default: the compiled round program is
     # bit-identical to the margins-less one (PERF_BASELINE pins this).
     margins: bool = False
+    # Numerics & determinism observatory (utils/numerics.py; ISSUE 20):
+    # in-jit numeric health counters — per-stage nonfinite counts
+    # (post-attack wire / post-quarantine / applied update), the
+    # gradient-norm dynamic range, the distance-Gram cancellation-depth
+    # estimate, and tie-proximity counters that band the PR 18 margin
+    # tensors at k ulp of their decision boundary (no new O(n^2 d)
+    # reductions) — emitted as one schema-v14 'numerics' event per
+    # round ('runs numerics' renders the health trajectories).  Works
+    # with any defense (the stage counters are defense-free); on a
+    # margin-bearing defense the kernels additionally report tie_rows /
+    # cancel_bits, which needs the same on-device score path --margins
+    # does.  Off by default: the compiled round program is bit-identical
+    # to the numerics-less one (PERF_BASELINE pins this).
+    numerics: bool = False
 
     def __post_init__(self):
         if self.model is not None and self.model in MODEL_FAMILY:
@@ -854,25 +868,33 @@ class ExperimentConfig:
         if self.local_steps < 1:
             raise ValueError(
                 f"local_steps must be >= 1, got {self.local_steps}")
+        _MARGIN_DEFENSES = ("Krum", "TrimmedMean", "Median", "Bulyan")
         if self.margins:
             # Margins are read from the ON-DEVICE score/rank tensors the
             # robust kernels already build; every config that never
             # materializes them is rejected here, loudly, with the
             # offending knob named (tests/test_margins.py pins the
             # message contract).
-            _MARGIN_DEFENSES = ("Krum", "TrimmedMean", "Median", "Bulyan")
             if self.defense not in _MARGIN_DEFENSES:
                 raise ValueError(
                     f"--margins measures a robust defense's decision "
                     f"margins; defense {self.defense!r} makes no "
                     f"selection/trim decision to measure (use one of "
                     f"{'/'.join(_MARGIN_DEFENSES)})")
+        if self.margins or (self.numerics
+                            and self.defense in _MARGIN_DEFENSES):
+            # The numerics tie-proximity counters reuse those same
+            # margin tensors (utils/numerics.py), so --numerics on a
+            # margin-bearing defense shares the on-device-impl
+            # requirement (on any other defense only the stage
+            # counters run and no impl constraint applies).
+            flag = "--margins" if self.margins else "--numerics"
             for knob in ("trimmed_mean_impl", "median_impl",
                          "bulyan_trim_impl", "distance_impl",
                          "bulyan_selection_impl"):
                 if getattr(self, knob) == "host":
                     raise ValueError(
-                        f"--margins reads the on-device score/rank "
+                        f"{flag} reads the on-device score/rank "
                         f"tensors inside the fused round program; "
                         f"{knob}='host' marshals that stage to a native "
                         f"kernel that returns only its aggregate, never "

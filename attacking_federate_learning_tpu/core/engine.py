@@ -64,6 +64,9 @@ from attacking_federate_learning_tpu.models.base import get_model
 from attacking_federate_learning_tpu.utils.costs import stage_scope
 from attacking_federate_learning_tpu.utils.flatten import make_flattener
 from attacking_federate_learning_tpu.utils.metrics import RunLogger
+from attacking_federate_learning_tpu.utils.numerics import (
+    nonfinite_count, norm_dynamic_range
+)
 
 
 def _jsonable(v):
@@ -770,8 +773,8 @@ class FederatedExperiment:
         return grads
 
     def _aggregate_impl(self, state: ServerState, grads, t, agg=None,
-                        telemetry=False, margins=False, mask=None,
-                        weights=None, action=None):
+                        telemetry=False, margins=False, numerics=False,
+                        mask=None, weights=None, action=None):
         """``agg`` pre-empts the defense call — the Krum-telemetry round
         computes the selection once and aggregates ``grads[sel]`` rather
         than running the O(n^2 d) distance engine twice.  ``telemetry``
@@ -817,6 +820,13 @@ class FederatedExperiment:
                         # gates --margins to exactly those), so the
                         # kwarg is only ever passed when True.
                         kw["margins"] = True
+                    if numerics:
+                        # Kernel tie/cancellation counters ride the
+                        # margin tensors (check_numerics_seam) — the
+                        # engine passes margins=True alongside and
+                        # filters margin fields back out when
+                        # --margins itself is off.
+                        kw["numerics"] = True
                     agg, ddiag = self.defense_fn(
                         grads, self.m, self.m_mal, telemetry=True, **kw)
                 else:
@@ -920,10 +930,19 @@ class FederatedExperiment:
         # quarantine mask, and only the defense call carries it.
         diag_select = (self._krum_select_fn
                        if (cfg.log_round_stats and not cfg.telemetry
-                           and not cfg.margins
+                           and not cfg.margins and not cfg.numerics
                            and self.faults is None
                            and self.traffic is None)
                        else None)
+
+        # Kernel-side numerics (ISSUE 20): the tie/cancellation
+        # counters band the margin tensors, so they exist only for the
+        # margin-bearing defenses; the engine-level health counters
+        # (nonfinite by stage, norm dynamic range) are defense-agnostic
+        # and keyed off cfg.numerics alone.
+        kernel_num = bool(cfg.numerics and cfg.defense in
+                          ("Krum", "TrimmedMean", "Median", "Bulyan"))
+        self._kernel_numerics = kernel_num
 
         def inject_and_quarantine(grads, t, fstate):
             """Fault seam (core/faults.py): inject the round-t faults
@@ -976,7 +995,19 @@ class FederatedExperiment:
             )
             with stage_scope("tier1_aggregate"):
                 for k, v in ddiag.items():
-                    if cfg.telemetry or k.startswith("margin_"):
+                    # Three-way filter: margin fields ride iff
+                    # --margins, num_ fields iff --numerics, the rest
+                    # iff full telemetry — so a numerics-only run's
+                    # margin carriers (check_numerics_seam forces the
+                    # margins kwarg on) are dropped here and DCE'd out
+                    # of the trace, and vice versa.
+                    if k.startswith("margin_"):
+                        if cfg.margins:
+                            tele["defense_" + k] = v
+                    elif k.startswith("num_"):
+                        if cfg.numerics:
+                            tele["defense_" + k] = v
+                    elif cfg.telemetry:
                         tele["defense_" + k] = v
                 if cfg.telemetry:
                     tele.update(population_telemetry(grads))
@@ -1018,6 +1049,16 @@ class FederatedExperiment:
                 if cfg.margins:
                     tele = {**tele,
                             **attack_margins(pre_attack, grads, state, t)}
+                if cfg.numerics:
+                    # Numeric health at the delivery seam: the crafted
+                    # wire matrix, before any quarantine can mask a
+                    # nonfinite row out of sight (utils/numerics.py).
+                    with stage_scope("deliver"):
+                        tele = {**tele,
+                                "num_nonfinite_pre":
+                                    nonfinite_count(grads),
+                                "num_range_log2":
+                                    norm_dynamic_range(grads)}
                 # ``grads`` stays the post-attack, PRE-fault matrix from
                 # here on (the nan guard must see what the attacker
                 # crafted — a dropout zeroing a malicious row must not
@@ -1044,12 +1085,19 @@ class FederatedExperiment:
                     agg_grads, sstats = self._secagg_step(agg_grads,
                                                           mask, t)
                     tele = {**tele, **sstats}
+                if cfg.numerics:
+                    # Post-quarantine: what the defense actually
+                    # aggregates (dead rows excluded by the mask).
+                    with stage_scope("quarantine"):
+                        tele = {**tele, "num_nonfinite_post":
+                                nonfinite_count(agg_grads, mask=mask)}
                 aux = {}
                 act = traffic[2] if traffic is not None else None
-                if cfg.telemetry or cfg.margins:
+                if cfg.telemetry or cfg.margins or kernel_num:
                     new_state, ddiag = self._aggregate_impl(
                         state, agg_grads, t, telemetry=True,
-                        margins=cfg.margins, mask=mask, action=act)
+                        margins=cfg.margins or kernel_num,
+                        numerics=kernel_num, mask=mask, action=act)
                     tele = finish_telemetry(tele, agg_grads, ddiag)
                     if (self._krum_select_fn is not None
                             and "selection_mask" in ddiag):
@@ -1066,6 +1114,13 @@ class FederatedExperiment:
                     new_state = self._aggregate_impl(state, agg_grads, t,
                                                      agg=agg, mask=mask,
                                                      action=act)
+                if cfg.numerics:
+                    # Post-apply: a nonfinite velocity is the server
+                    # update already poisoned, whatever the cohort
+                    # counters said.
+                    with stage_scope("apply"):
+                        tele = {**tele, "num_nonfinite_agg":
+                                nonfinite_count(new_state.velocity)}
                 return new_state, grads, aux, tele, fstate
 
             def crafted_nonfinite(grads):
@@ -1243,9 +1298,11 @@ class FederatedExperiment:
                               # the jitted aggregate resolves 'auto' to
                               # 'xla' and threads the quarantine mask.
                               and self.faults is None
-                              # Margins read the on-device scores; the
-                              # eager host engines never return them.
-                              and not cfg.margins)
+                              # Margins (and the numerics counters that
+                              # band them) read the on-device scores;
+                              # the eager host engines never return
+                              # them.
+                              and not (cfg.margins or kernel_num))
             self._aggregate = (self._aggregate_impl if eager_host_agg
                                else jax.jit(self._aggregate_impl,
                                             **self._donate_kw()))
@@ -1254,13 +1311,16 @@ class FederatedExperiment:
                 # fault seam runs as its own small jitted step between
                 # the (host) attack craft and the aggregation.
                 self._fault_step = jax.jit(inject_and_quarantine)
-            if cfg.telemetry or cfg.margins:
+            if cfg.telemetry or cfg.margins or kernel_num:
                 # telemetry is a trace-time (static) flag, so the
                 # telemetry aggregate is its own jitted function
-                # (margins ride the same diagnostics pytree).
+                # (margins and the kernel numerics counters ride the
+                # same diagnostics pytree).
                 agg_tele = functools.partial(self._aggregate_impl,
                                              telemetry=True,
-                                             margins=cfg.margins)
+                                             margins=(cfg.margins
+                                                      or kernel_num),
+                                             numerics=kernel_num)
                 self._aggregate_tele = (agg_tele if eager_host_agg
                                         else jax.jit(
                                             agg_tele,
@@ -1344,6 +1404,11 @@ class FederatedExperiment:
         # groupwise secagg is structurally margin-free (config pins
         # the defense to NoDefense there, which --margins rejects).
         marg_on = cfg.margins
+        # Numerics ride the same two-tier seam (ISSUE 20): per-shard
+        # kernel tie counters stack into shard_num_*, the tier-2
+        # reduction's into tier2_num_*; groupwise secagg pins
+        # NoDefense, whose kernels accept-and-ignore the flag.
+        num_on = cfg.numerics
         # Per-client gradient norms are observable only in the CLEAR
         # hierarchical modes: under groupwise secagg the server sees
         # group sums, not rows, so the shard norm stack (and the
@@ -1354,7 +1419,17 @@ class FederatedExperiment:
         # Any extra per-shard output switches shard_fn to the dict
         # pytree; with everything off the return structure (and the
         # traced program) is byte-for-byte the pre-telemetry tuple.
-        extras = tele_on or cfg.log_round_stats or marg_on
+        extras = tele_on or cfg.log_round_stats or marg_on or num_on
+
+        def keep_diag(k):
+            # The hier twin of the flat engine's three-way telemetry
+            # filter: margin fields ride iff --margins, num_ fields
+            # iff --numerics, everything else iff full telemetry.
+            if k.startswith("margin_"):
+                return marg_on
+            if k.startswith("num_"):
+                return num_on
+            return tele_on
 
         def megabatch_grads(ids, c_mal, state, t):
             """Deliver + train + attack for one megabatch — the shared
@@ -1449,16 +1524,20 @@ class FederatedExperiment:
                 est = self.defense_fn(grads, m, f1)
                 return est.astype(jnp.float32), bad
             out = {"bad": bad}
-            if tele_on or marg_on:
-                dkw = {"margins": True} if marg_on else {}
+            if tele_on or marg_on or num_on:
+                dkw = {}
+                if marg_on or num_on:
+                    dkw["margins"] = True
+                if num_on:
+                    dkw["numerics"] = True
                 est, diag = self.defense_fn(grads, m, f1,
                                             telemetry=True, **dkw)
-                if not tele_on:
-                    # Margins-only: the full diagnostics never leave
-                    # the shard — just the margin fields (the stacked
-                    # (S, ...) shard_margin_* record).
-                    diag = {k: v for k, v in diag.items()
-                            if k.startswith("margin_")}
+                # Margins/numerics-only: the full diagnostics never
+                # leave the shard — just the flagged fields (the
+                # stacked (S, ...) shard_margin_* / shard_num_*
+                # records); a numerics-only run's forced margin
+                # carriers are dropped here and DCE'd in-trace.
+                diag = {k: v for k, v in diag.items() if keep_diag(k)}
                 out["diag"] = diag
             else:
                 est = self.defense_fn(grads, m, f1)
@@ -1520,27 +1599,45 @@ class FederatedExperiment:
                         env = group_envelope_stats(ests, m)
                         tele["secagg_group_cos_to_mean"] = (
                             env["group_cos_to_mean"])
-            if tele_on or marg_on:
+            if tele_on or marg_on or num_on:
                 if diag1:
                     for dk, dv in diag1.items():
                         tele["shard_" + dk] = dv
                 if norms is not None and tele_on:
                     tele["shard_grad_norms"] = norms
-                t2kw = {"margins": True} if marg_on else {}
+                t2kw = {}
+                if marg_on or num_on:
+                    t2kw["margins"] = True
+                if num_on:
+                    t2kw["numerics"] = True
                 agg, diag2 = shard_reduce(tier2_fn, ests, S, f2,
                                           plan=t2_plan,
                                           telemetry=True, **t2kw)
                 with stage_scope("tier2_aggregate"):
                     for dk, dv in diag2.items():
-                        if tele_on or dk.startswith("margin_"):
+                        if keep_diag(dk):
                             tele["tier2_" + dk] = dv
                     if tele_on:
                         tele["tier2_est_norms"] = jnp.linalg.norm(
                             ests.astype(jnp.float32), axis=1)
+                if num_on:
+                    # Engine-level health at the tier boundary: the
+                    # (S, d) estimate matrix the tier-2 reduction
+                    # aggregates (per-shard wire health is in the
+                    # stacked shard_num_* fields).
+                    with stage_scope("tier2_aggregate"):
+                        tele["num_nonfinite_post"] = nonfinite_count(
+                            ests)
+                        tele["num_range_log2"] = norm_dynamic_range(
+                            ests)
             else:
                 agg = shard_reduce(tier2_fn, ests, S, f2,
                                    plan=t2_plan)
             new_state = self._aggregate_impl(state, None, t, agg=agg)
+            if num_on:
+                with stage_scope("apply"):
+                    tele["num_nonfinite_agg"] = nonfinite_count(
+                        new_state.velocity)
             bad = (bads.any() if self._check_attack_nan
                    else jnp.asarray(False))
             diag = {}
@@ -1690,14 +1787,17 @@ class FederatedExperiment:
                     with stage_scope("quarantine"):
                         clean, qmask, qstats = quarantine(faulted, drop)
                     out["f_quarantined"] = qstats["fault_quarantined"]
-                    if tele_on or marg_on:
-                        dkw = {"margins": True} if marg_on else {}
+                    if tele_on or marg_on or num_on:
+                        dkw = {}
+                        if marg_on or num_on:
+                            dkw["margins"] = True
+                        if num_on:
+                            dkw["numerics"] = True
                         est, diag = self.defense_fn(
                             clean, m, f1, mask=qmask, telemetry=True,
                             **dkw)
-                        if not tele_on:
-                            diag = {k: v for k, v in diag.items()
-                                    if k.startswith("margin_")}
+                        diag = {k: v for k, v in diag.items()
+                                if keep_diag(k)}
                         out["diag"] = diag
                     else:
                         est = self.defense_fn(clean, m, f1, mask=qmask)
@@ -1786,25 +1886,35 @@ class FederatedExperiment:
                             tele["secagg_group_cos_to_mean"] = (
                                 env["group_cos_to_mean"])
                 norms = out.get("norms")
-                if tele_on or marg_on:
+                if tele_on or marg_on or num_on:
                     diag1 = out.get("diag")
                     if diag1:
                         for dk, dv in diag1.items():
                             tele["shard_" + dk] = dv
                     if norms is not None and tele_on:
                         tele["shard_grad_norms"] = norms
-                    t2kw = {"margins": True} if marg_on else {}
+                    t2kw = {}
+                    if marg_on or num_on:
+                        t2kw["margins"] = True
+                    if num_on:
+                        t2kw["numerics"] = True
                     agg, diag2 = shard_reduce(tier2_fn, ests, S, f2,
                                               alive_counts=alive,
                                               plan=t2_plan,
                                               telemetry=True, **t2kw)
                     with stage_scope("tier2_aggregate"):
                         for dk, dv in diag2.items():
-                            if tele_on or dk.startswith("margin_"):
+                            if keep_diag(dk):
                                 tele["tier2_" + dk] = dv
                         if tele_on:
                             tele["tier2_est_norms"] = jnp.linalg.norm(
                                 ests.astype(jnp.float32), axis=1)
+                    if num_on:
+                        with stage_scope("tier2_aggregate"):
+                            tele["num_nonfinite_post"] = (
+                                nonfinite_count(ests))
+                            tele["num_range_log2"] = (
+                                norm_dynamic_range(ests))
                 else:
                     agg = shard_reduce(tier2_fn, ests, S, f2,
                                        alive_counts=alive, plan=t2_plan)
@@ -1821,6 +1931,10 @@ class FederatedExperiment:
                 # jnp.where after the momentum update).
                 new_state = self._aggregate_impl(state, None, t, agg=agg,
                                                  action=action)
+                if num_on:
+                    with stage_scope("apply"):
+                        tele["num_nonfinite_agg"] = nonfinite_count(
+                            new_state.velocity)
                 bad = (bads.any() if self._check_attack_nan
                        else jnp.asarray(False))
                 diag = {}
@@ -1882,7 +1996,7 @@ class FederatedExperiment:
         donate = self._donate_kw()
         self._fused_round = jax.jit(fused, **donate)
         self._fused_span = jax.jit(fused_span, **donate)
-        if groupwise or cfg.telemetry or cfg.margins:
+        if groupwise or cfg.telemetry or cfg.margins or cfg.numerics:
             self._tele_span = jax.jit(tele_span, static_argnums=2,
                                       **donate)
         self._staged = False
@@ -1931,6 +2045,12 @@ class FederatedExperiment:
         from attacking_federate_learning_tpu.defenses.kernels import (
             population_telemetry
         )
+        # Same predicate as the flat builder (ISSUE 20): kernel
+        # tie/cancellation counters exist only for the margin-bearing
+        # defenses.
+        kernel_num = bool(cfg.numerics and cfg.defense in
+                          ("Krum", "TrimmedMean", "Median", "Bulyan"))
+        self._kernel_numerics = kernel_num
 
         spec = self._async
         D = spec.depth
@@ -2003,8 +2123,18 @@ class FederatedExperiment:
                     {"margin_attack_" + k: v for k, v in ms.items()})
             bad = (crafted_nonfinite(crafted)
                    if self._check_attack_nan else jnp.asarray(False))
+            if cfg.numerics:
+                with stage_scope("deliver"):
+                    tele.update(
+                        num_nonfinite_pre=nonfinite_count(crafted),
+                        num_range_log2=norm_dynamic_range(
+                            crafted, mask=delivered))
             with stage_scope("quarantine"):
                 agg_grads = jnp.where(delivered[:, None], crafted, 0.0)
+            if cfg.numerics:
+                with stage_scope("quarantine"):
+                    tele["num_nonfinite_post"] = nonfinite_count(
+                        agg_grads, mask=delivered)
             with stage_scope("deliver"):
                 weights = staleness_weights(staleness, delivered,
                                             spec.weighting)
@@ -2016,14 +2146,24 @@ class FederatedExperiment:
                 bucket = staleness[None, :] == jnp.arange(D)[:, None]
                 tele["async_weight_mass"] = jnp.sum(
                     bucket * w_eff[None, :], axis=1).astype(jnp.float32)
-            if cfg.telemetry or cfg.margins:
+            if cfg.telemetry or cfg.margins or kernel_num:
                 upd, ddiag = self._aggregate_impl(
                     state, agg_grads, t, telemetry=True,
-                    margins=cfg.margins, mask=delivered,
+                    margins=cfg.margins or kernel_num,
+                    numerics=kernel_num, mask=delivered,
                     weights=weights)
                 with stage_scope("tier1_aggregate"):
                     for dk, dv in ddiag.items():
-                        if cfg.telemetry or dk.startswith("margin_"):
+                        # Same three-way filter as the flat engine's
+                        # finish_telemetry (margin_ iff --margins,
+                        # num_ iff --numerics, rest iff telemetry).
+                        if dk.startswith("margin_"):
+                            if cfg.margins:
+                                tele["defense_" + dk] = dv
+                        elif dk.startswith("num_"):
+                            if cfg.numerics:
+                                tele["defense_" + dk] = dv
+                        elif cfg.telemetry:
                             tele["defense_" + dk] = dv
                     if cfg.telemetry:
                         tele.update(population_telemetry(agg_grads))
@@ -2041,6 +2181,10 @@ class FederatedExperiment:
                     velocity=jnp.where(any_del, upd.velocity,
                                        state.velocity),
                     round=upd.round)
+            if cfg.numerics:
+                with stage_scope("apply"):
+                    tele["num_nonfinite_agg"] = nonfinite_count(
+                        new_state.velocity)
             diag = {}
             if cfg.log_round_stats:
                 # Norm stats over the COMPUTED cohort (what clients
@@ -2227,11 +2371,12 @@ class FederatedExperiment:
                         (span_name, lambda: self._fused_span.lower(
                             self.state, t0,
                             jnp.asarray(span_len, jnp.int32))))
-                    if cfg.telemetry or cfg.margins:
+                    if cfg.telemetry or cfg.margins or cfg.numerics:
                         # Hierarchical engines ledger their telemetry
                         # span under their own name so the perf gate
                         # can pin the hier-tele cost cells separately
-                        # (margins ride the same span entry point).
+                        # (margins and numerics ride the same span
+                        # entry point).
                         entries.append(
                             ("hier_tele_span" if hier else "tele_span",
                              lambda: self._tele_span.lower(
@@ -2252,7 +2397,8 @@ class FederatedExperiment:
                 # (host BLAS) — nothing compiled to analyze there.
                 entries.append(("aggregate", lambda: self._aggregate.lower(
                     self.state, grads_sds, t0)))
-            if ((cfg.telemetry or cfg.margins)
+            if ((cfg.telemetry or cfg.margins
+                    or getattr(self, "_kernel_numerics", False))
                     and hasattr(self._aggregate_tele, "lower")):
                 entries.append(
                     ("aggregate_tele", lambda: self._aggregate_tele.lower(
@@ -2444,7 +2590,7 @@ class FederatedExperiment:
             return "traffic_span"
         if self.faults is not None:
             return "fault_span"
-        if (self.cfg.telemetry or self.cfg.margins
+        if (self.cfg.telemetry or self.cfg.margins or self.cfg.numerics
                 or self._secagg is not None):
             return "hier_tele_span" if hier else "tele_span"
         return "hier_span" if hier else "fused_span"
@@ -2482,7 +2628,7 @@ class FederatedExperiment:
                     low = self._fault_span.lower(
                         self.state, t0, int(count), self._fault_state)
             elif (self.cfg.telemetry or self.cfg.margins
-                    or self._secagg is not None):
+                    or self.cfg.numerics or self._secagg is not None):
                 low = self._tele_span.lower(self.state, t0, int(count))
             else:
                 # Span length is a traced operand: one compilation
@@ -2634,11 +2780,12 @@ class FederatedExperiment:
                                          int(count), self._fault_state))
                 self.last_span_telemetry = (int(start), stacked)
             elif (self.cfg.telemetry or self.cfg.margins
-                    or self._secagg is not None):
-                # secagg and margins ride the telemetry span too: their
-                # per-round stats (sum-check verdicts / margin fields)
-                # must come back stacked even with cfg.telemetry off,
-                # exactly like the fault counts do under faults.
+                    or self.cfg.numerics or self._secagg is not None):
+                # secagg, margins and numerics ride the telemetry span
+                # too: their per-round stats (sum-check verdicts /
+                # margin fields / numeric-health counters) must come
+                # back stacked even with cfg.telemetry off, exactly
+                # like the fault counts do under faults.
                 self.state, bad, stacked = self._tele_span(
                     self.state, jnp.asarray(start, jnp.int32), int(count))
                 self.last_span_telemetry = (int(start), stacked)
@@ -2708,13 +2855,23 @@ class FederatedExperiment:
             if self.cfg.margins:
                 tele = {**tele, **self._attack_margins(
                     pre_attack, grads, self.state, t)}
+            if self.cfg.numerics:
+                # Staged twin of the fused engine counters (eager —
+                # the staged path crosses the host every round anyway).
+                tele = {**tele,
+                        "num_nonfinite_pre": nonfinite_count(grads),
+                        "num_range_log2": norm_dynamic_range(grads)}
             mask = None
             if self.faults is not None:
                 grads, mask, self._fault_state, fstats = self._fault_step(
                     grads, t, self._fault_state)
                 tele = {**tele, **fstats}
+            if self.cfg.numerics:
+                tele = {**tele, "num_nonfinite_post":
+                        nonfinite_count(grads, mask=mask)}
             aux = {}
-            if self.cfg.telemetry or self.cfg.margins:
+            if (self.cfg.telemetry or self.cfg.margins
+                    or getattr(self, "_kernel_numerics", False)):
                 # The defense returns its own diagnostics (single
                 # distance computation; the Krum mask marks the
                 # aggregated row by construction).
@@ -2742,6 +2899,10 @@ class FederatedExperiment:
                                              mask=mask)
                 if tele:
                     self.last_round_telemetry = tele
+            if self.cfg.numerics:
+                tele = {**tele, "num_nonfinite_agg":
+                        nonfinite_count(self.state.velocity)}
+                self.last_round_telemetry = tele
             if self.cfg.log_round_stats:
                 self.last_round_stats = self._round_diagnostics(
                     grads, self.state, t, aux)
@@ -2769,7 +2930,9 @@ class FederatedExperiment:
         'fault' event, its 'secagg_*' protocol stats as a 'secagg'
         event (both emitted with or without telemetry), its margin
         fields as one schema-v12 'margin' event (cfg.margins — also
-        with or without telemetry), and — for hierarchical rounds —
+        with or without telemetry), its numeric-health counters as one
+        schema-v14 'numerics' event (cfg.numerics — likewise
+        independent of telemetry), and — for hierarchical rounds —
         its 'shard_*'/'tier2_*' stacks as one schema-v6
         'shard_selection' event; track Krum winners for the
         end-of-run selection histogram."""
@@ -2777,17 +2940,31 @@ class FederatedExperiment:
         fault_fields, secagg_fields, shard_fields = {}, {}, {}
         async_fields = {}
         margin_fields, margin_attack, hier_margin = {}, {}, {}
+        numerics_fields = {}
         for k, v in tele.items():
             val = _jsonable(v)
-            # Margin prefixes are checked FIRST: 'defense_margin_*' /
-            # 'shard_margin_*' / 'tier2_margin_*' would otherwise be
-            # swallowed by the defense/shard branches below.
+            # Margin/numerics prefixes are checked FIRST:
+            # 'defense_margin_*' / 'shard_margin_*' / 'tier2_margin_*'
+            # (and the num_ twins) would otherwise be swallowed by the
+            # defense/shard branches below.
             if k.startswith("defense_margin_"):
                 margin_fields[k[len("defense_"):]] = val
             elif k.startswith("margin_attack_"):
                 margin_attack[k[len("margin_attack_"):]] = val
             elif k.startswith(("shard_margin_", "tier2_margin_")):
                 hier_margin[k] = val
+            elif k.startswith("defense_num_"):
+                # Kernel tie/cancellation counters: 'defense_num_x'
+                # lands as bare 'x' in the v14 'numerics' event.
+                numerics_fields[k[len("defense_num_"):]] = val
+            elif k.startswith(("shard_num_", "tier2_num_")):
+                # Hier stacks keep their tier prefix, drop 'num_':
+                # 'shard_num_tie_rows' -> 'shard_tie_rows'.
+                tier, rest = k.split("num_", 1)
+                numerics_fields[tier + rest] = val
+            elif k.startswith("num_"):
+                # Engine-level health counters.
+                numerics_fields[k[len("num_"):]] = val
             elif k.startswith("attack_"):
                 attack_fields[k[len("attack_"):]] = val
             elif k.startswith("async_"):
@@ -2868,6 +3045,21 @@ class FederatedExperiment:
             logger.record(kind="margin", round=int(t),
                           defense=self.cfg.defense,
                           malicious_count=self.m_mal, **ev)
+        if self.cfg.numerics and numerics_fields:
+            # One schema-v14 'numerics' event per round: engine-level
+            # health counters (nonfinite by stage, norm dynamic range),
+            # the kernel tie/cancellation counters (flat or as hier
+            # shard_/tier2_ stacks), and the host rollups
+            # (utils/numerics.py — nonfinite_total, tie_locked), all
+            # stamped with the tie band they were measured at.
+            from attacking_federate_learning_tpu.utils.numerics import (
+                TIE_BAND_ULPS, numerics_rollups
+            )
+            nev = dict(numerics_fields)
+            nev.update(numerics_rollups(numerics_fields))
+            logger.record(kind="numerics", round=int(t),
+                          defense=self.cfg.defense,
+                          tie_band_ulps=TIE_BAND_ULPS, **nev)
         if not self.cfg.telemetry:
             return
         if shard_fields:
@@ -3093,7 +3285,7 @@ class FederatedExperiment:
                         self._book_span_walls(logger, trace_dir, count)
                 else:
                     self.run_span(epoch, count)
-                if ((cfg.telemetry or cfg.margins
+                if ((cfg.telemetry or cfg.margins or cfg.numerics
                         or self.faults is not None
                         or self._secagg is not None
                         or self._async is not None)
@@ -3128,7 +3320,7 @@ class FederatedExperiment:
                     logger.record(kind="round", round=epoch,
                                   **{k: float(v) for k, v in
                                      self.last_round_stats.items()})
-                if ((cfg.telemetry or cfg.margins
+                if ((cfg.telemetry or cfg.margins or cfg.numerics
                         or self.faults is not None
                         or self._secagg is not None
                         or self._async is not None)

@@ -54,6 +54,10 @@ from attacking_federate_learning_tpu.utils.costs import stage_scope
 from attacking_federate_learning_tpu.utils.margins import (
     krum_margins, rank_keep_margins
 )
+from attacking_federate_learning_tpu.utils.numerics import (
+    cancellation_bits, gram_cancellation_bits, max_finite_abs,
+    tie_proximity
+)
 from attacking_federate_learning_tpu.utils.plugins import Registry
 
 
@@ -92,6 +96,19 @@ def check_margin_seam(margins, telemetry):
         raise ValueError(
             "defense margins=True requires telemetry=True (margin "
             "fields ride the diagnostics pytree; utils/margins.py)")
+
+
+def check_numerics_seam(numerics, margins):
+    """The ``numerics=`` seam (ISSUE 20) rides the margin tensors — a
+    kernel's tie-proximity counters band the PR 18 margins at k ulp of
+    the decision boundary, so numerics without margins has nothing to
+    band and is a caller bug (core/engine.py passes margins=True
+    whenever kernel numerics are on, even with --margins off, and
+    filters the margin fields back out of the event stream)."""
+    if numerics and not margins:
+        raise ValueError(
+            "defense numerics=True requires margins=True (tie counters "
+            "band the margin tensors; utils/numerics.py)")
 
 
 _INF = jnp.inf
@@ -259,7 +276,7 @@ def population_telemetry(users_grads):
 
 @DEFENSES.register("NoDefense")
 def no_defense(users_grads, users_count, corrupted_count, telemetry=False,
-               mask=None, weights=None, margins=False):
+               mask=None, weights=None, margins=False, numerics=False):
     """Plain FedAvg mean (reference defences.py:13-14).  ``mask`` (the
     quarantine seam, core/faults.py): mean over the alive rows only —
     a zeroed dropout row must not drag the average toward zero.
@@ -268,9 +285,12 @@ def no_defense(users_grads, users_count, corrupted_count, telemetry=False,
     FedBuff's staleness-discounted aggregate.  ``margins=`` is
     accepted and ignored (a mean has no decision boundary to measure;
     config rejects --margins for a NoDefense tier-1, but the tier-2
-    ``shard_mean`` wrapper forwards the flag here)."""
+    ``shard_mean`` wrapper forwards the flag here).  ``numerics=`` is
+    likewise accepted and ignored (no decision boundary, no tie band;
+    the engine-level health counters cover mean aggregation)."""
     check_weight_seam(mask, weights)
     check_margin_seam(margins, telemetry)
+    check_numerics_seam(numerics, margins)
     if weights is not None:
         w = jnp.where(mask, weights, 0.0)
         agg = (w @ users_grads.astype(jnp.float32)) / jnp.maximum(
@@ -499,7 +519,7 @@ def krum_select(users_grads, users_count, corrupted_count,
 def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
          method="sort", distance_impl="xla", D=None, distance_dtype=None,
          telemetry=False, mask=None, weights=None, scores_impl="xla",
-         margins=False):
+         margins=False, numerics=False):
     """Krum selection (reference defences.py:23-42): the single gradient
     whose summed distance to its k nearest peers is minimal.
 
@@ -539,8 +559,19 @@ def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
     winner/runner-up score gap (utils/margins.py:krum_margins).  Needs
     a score-returning engine: the scalar-index host path has no score
     vector to measure and raises.
+
+    ``numerics=True`` (requires ``margins=True``; ISSUE 20)
+    additionally returns ``num_tie_rows`` () int32 — rows whose
+    selection margin sits within TIE_BAND_ULPS ulp (at the winner
+    score's magnitude) of the boundary — and ``num_cancel_bits`` ()
+    f32 — a documented cancellation-depth ESTIMATE: 2*max||g||^2 (the
+    largest possible ||a||^2+||b||^2-2ab accumuland) against the
+    winner's mean kept distance, since the (n, n) Gram is not in scope
+    here and recomputing it would double the distance work
+    (utils/numerics.py).
     """
     check_margin_seam(margins, telemetry)
+    check_numerics_seam(numerics, margins)
     if not telemetry:
         idx = krum_select(users_grads, users_count, corrupted_count,
                           paper_scoring=paper_scoring, method=method,
@@ -568,11 +599,24 @@ def krum(users_grads, users_count, corrupted_count, paper_scoring=False,
                 "distance_impl='host' returns only the winner index "
                 "(defenses/host.py)")
         diag.update(krum_margins(scores, idx, mask=mask))
+        if numerics:
+            win = scores_out[idx]
+            diag["num_tie_rows"] = tie_proximity(
+                diag["margin_selection"], win)
+            k_kept = jnp.maximum(
+                (jnp.sum(mask) if mask is not None else users_count)
+                - corrupted_count, 1).astype(jnp.float32)
+            g32 = users_grads.astype(jnp.float32)
+            sq = jnp.sum(g32 * g32, axis=1)
+            if mask is not None:
+                sq = jnp.where(mask, sq, 0.0)
+            diag["num_cancel_bits"] = cancellation_bits(
+                2.0 * jnp.max(sq), win / k_kept)
     return agg, diag
 
 
 def trimmed_mean_of(users_grads, number_to_consider, impl="xla",
-                    telemetry=False, margins=False):
+                    telemetry=False, margins=False, numerics=False):
     """Median-anchored trimmed mean along the client axis.
 
     Per coordinate (reference defences.py:48-51): subtract the median, keep
@@ -605,15 +649,26 @@ def trimmed_mean_of(users_grads, number_to_consider, impl="xla",
     kernel still reports NaN ``kept_fraction``) and the two impls'
     margins are bit-identical by construction; the host kernel runs
     off-device and raises.
+
+    ``numerics=True`` (requires ``margins=True``; ISSUE 20)
+    additionally returns ``num_tie_rows`` () int32 — per-coordinate
+    boundary distances within TIE_BAND_ULPS ulp of the trim cut,
+    banded at the deviation key's largest finite magnitude
+    (utils/numerics.py).
     """
     check_margin_seam(margins, telemetry)
+    check_numerics_seam(numerics, margins)
     n = users_grads.shape[0]
     trim_frac = jnp.float32(1.0 - number_to_consider / n)
 
     def margin_fields():
         med = jnp.median(users_grads, axis=0)
-        return rank_keep_margins(jnp.abs(users_grads - med[None, :]),
-                                 number_to_consider)
+        key = jnp.abs(users_grads - med[None, :])
+        mf = rank_keep_margins(key, number_to_consider)
+        if numerics:
+            mf["num_tie_rows"] = tie_proximity(
+                mf["margin_boundary_dist"], max_finite_abs(key))
+        return mf
 
     if impl == "pallas":
         from attacking_federate_learning_tpu.ops.pallas_defense import (
@@ -656,15 +711,19 @@ def trimmed_mean_of(users_grads, number_to_consider, impl="xla",
                  .at[kept_rows.reshape(-1)].add(1.0) / d)
     diag = {"kept_fraction": kept_frac, "trim_fraction": trim_frac}
     if margins:
-        diag.update(rank_keep_margins(jnp.abs(dev), number_to_consider,
-                                      order=order))
+        key = jnp.abs(dev)
+        mf = rank_keep_margins(key, number_to_consider, order=order)
+        if numerics:
+            mf["num_tie_rows"] = tie_proximity(
+                mf["margin_boundary_dist"], max_finite_abs(key))
+        diag.update(mf)
     return agg, diag
 
 
 @DEFENSES.register("TrimmedMean")
 def trimmed_mean(users_grads, users_count, corrupted_count, impl="xla",
                  telemetry=False, mask=None, weights=None,
-                 margins=False):
+                 margins=False, numerics=False):
     """Reference defences.py:44-52; keeps n - f - 1 coordinates.
 
     ``impl='host'`` (opt-in, config ``trimmed_mean_impl``) routes to the
@@ -690,8 +749,12 @@ def trimmed_mean(users_grads, users_count, corrupted_count, impl="xla",
     ``margins=True``: see :func:`trimmed_mean_of`; the masked variant
     ranks by the same alive-anchored key as
     :func:`masked_trimmed_mean_of` (dead rows +inf -> -inf boundary
-    distance, zero kept fraction)."""
+    distance, zero kept fraction).  ``numerics=True``: see
+    :func:`trimmed_mean_of` (the masked tie band is measured on the
+    same alive-anchored key, whose dead-row +inf sentinels the
+    finite-magnitude scale excludes)."""
     check_margin_seam(margins, telemetry)
+    check_numerics_seam(numerics, margins)
     if mask is not None:
         if impl == "host":
             raise ValueError(
@@ -728,11 +791,16 @@ def trimmed_mean(users_grads, users_count, corrupted_count, impl="xla",
             key = jnp.where(mask[:, None],
                             jnp.abs(users_grads - med[None, :]), _INF)
             k = jnp.maximum(e - corrupted_count - 1, 1)
-            diag.update(rank_keep_margins(key, k))
+            mf = rank_keep_margins(key, k)
+            if numerics:
+                mf["num_tie_rows"] = tie_proximity(
+                    mf["margin_boundary_dist"], max_finite_abs(key))
+            diag.update(mf)
         return agg, diag
     number_to_consider = users_grads.shape[0] - corrupted_count - 1
     return trimmed_mean_of(users_grads, number_to_consider, impl=impl,
-                           telemetry=telemetry, margins=margins)
+                           telemetry=telemetry, margins=margins,
+                           numerics=numerics)
 
 
 def host_coordwise(host_fn, users_grads):
@@ -807,7 +875,8 @@ def _bulyan_diag(n, selected, Dm, users_count, corrupted_count,
 def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
            method="sort", distance_impl="xla", D=None, batch_select=1,
            distance_dtype=None, selection_impl="xla", trim_impl="xla",
-           telemetry=False, mask=None, weights=None, margins=False):
+           telemetry=False, mask=None, weights=None, margins=False,
+           numerics=False):
     """Bulyan (reference defences.py:55-70): iteratively Krum-select
     n - 2f gradients (removing each winner from the pool, with the pool
     size — but not f — shrinking), then trim-mean the selection with
@@ -900,8 +969,17 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
     its client slot (zero for unselected rows).  Both off-device
     selection engines raise: the full-host path returns only the
     aggregate and the hybrid's native selection never ships per-trip
-    scores back."""
+    scores back.
+
+    ``numerics=True`` (requires ``margins=True``; ISSUE 20)
+    additionally returns ``num_tie_rows`` () int32 — rows whose
+    selection margin sits within TIE_BAND_ULPS ulp of the final trip's
+    cut (the PR 18 tie-lock counter: the IID collapse pins this > 0
+    every round) — and ``num_cancel_bits`` () f32 — the measured
+    cancellation depth of the (n, n) distance Gram, the tie-band
+    driver (utils/numerics.py:gram_cancellation_bits)."""
     check_margin_seam(margins, telemetry)
+    check_numerics_seam(numerics, margins)
     n, _ = users_grads.shape
     f = corrupted_count
     set_size = users_count - 2 * f
@@ -1121,6 +1199,11 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
             diag["margin_trim_kept"] = jnp.zeros(
                 (n,), jnp.float32).at[selected].set(
                 jnp.where(sel_mask, tm["margin_kept_frac"], 0.0))
+            if numerics:
+                diag["num_tie_rows"] = tie_proximity(
+                    diag["margin_selection"], cut)
+                diag["num_cancel_bits"] = gram_cancellation_bits(
+                    Dm, mask=mask)
         return agg, diag
 
     # Presort once for the traced selection loop.
@@ -1202,6 +1285,10 @@ def bulyan(users_grads, users_count, corrupted_count, paper_scoring=False,
         diag["margin_slack"] = slack
         diag["margin_trim_kept"] = jnp.zeros(
             (n,), jnp.float32).at[selected].set(tm["margin_kept_frac"])
+        if numerics:
+            diag["num_tie_rows"] = tie_proximity(
+                diag["margin_selection"], cut)
+            diag["num_cancel_bits"] = gram_cancellation_bits(Dm)
     return agg, diag
 
 
@@ -1243,17 +1330,19 @@ def _alive_to_mask(alive_counts):
 
 
 def shard_mean(shard_estimates, shard_count, corrupted_shards,
-               alive_counts=None, telemetry=False, margins=False):
+               alive_counts=None, telemetry=False, margins=False,
+               numerics=False):
     """Tier-2 NoDefense: alive-count-weighted mean of the shard
     estimates — with equal megabatches and no faults this is exactly
     the flat FedAvg mean (each estimate already averages m clients);
     with faults the weights restore the flat masked mean's
     per-client weighting.  ``telemetry=True`` returns ``(agg, {})`` —
     a mean rejects nothing, so there is nothing to attribute (and
-    ``margins=`` is likewise accepted and ignored: no decision
-    boundary, no margin fields)."""
+    ``margins=`` / ``numerics=`` are likewise accepted and ignored: no
+    decision boundary, no margin fields, no tie band)."""
     del corrupted_shards
     check_margin_seam(margins, telemetry)
+    check_numerics_seam(numerics, margins)
     if alive_counts is None:
         agg = jnp.mean(shard_estimates, axis=0)
     else:

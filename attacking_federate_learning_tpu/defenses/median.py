@@ -15,7 +15,8 @@ from attacking_federate_learning_tpu.defenses.kernels import DEFENSES
 
 @DEFENSES.register("Median")
 def median(users_grads, users_count, corrupted_count, impl="xla",
-           telemetry=False, mask=None, weights=None, margins=False):
+           telemetry=False, mask=None, weights=None, margins=False,
+           numerics=False):
     """``impl='host'`` (opt-in, config ``median_impl``) routes to the
     native column-blocked kernel (native/bulyan_select.cpp:fl_median) —
     same rationale and same non-auto-dispatch rule as
@@ -44,12 +45,18 @@ def median(users_grads, users_count, corrupted_count, impl="xla",
     reconstruct the aggregate) and its inside-positive proximity to
     the rank-derived median.  Pure-XLA rank ops independent of
     ``impl``, so the pallas route gets bit-identical margins; the
-    off-device host kernel raises."""
+    off-device host kernel raises.
+
+    ``numerics=True`` (requires ``margins=True``; ISSUE 20)
+    additionally returns ``num_tie_rows`` () int32 — boundary
+    distances within TIE_BAND_ULPS ulp of the median pick, banded at
+    the input's largest finite magnitude (utils/numerics.py)."""
     from attacking_federate_learning_tpu.defenses.kernels import (
-        check_margin_seam, check_weight_seam
+        check_margin_seam, check_numerics_seam, check_weight_seam
     )
     check_weight_seam(mask, weights)
     check_margin_seam(margins, telemetry)
+    check_numerics_seam(numerics, margins)
     if margins and impl == "host":
         raise ValueError(
             "Median margins need the on-device ranks; impl='host' "
@@ -59,8 +66,16 @@ def median(users_grads, users_count, corrupted_count, impl="xla",
         from attacking_federate_learning_tpu.utils.margins import (
             median_pick_margins
         )
-        return median_pick_margins(users_grads, mask=mask,
-                                   weights=weights)
+        mf = median_pick_margins(users_grads, mask=mask, weights=weights)
+        if numerics:
+            from attacking_federate_learning_tpu.utils.numerics import (
+                max_finite_abs, tie_proximity
+            )
+            key = users_grads if mask is None else jnp.where(
+                mask[:, None], users_grads, jnp.inf)
+            mf["num_tie_rows"] = tie_proximity(
+                mf["margin_boundary_dist"], max_finite_abs(key))
+        return mf
 
     if mask is not None:
         if impl == "host":

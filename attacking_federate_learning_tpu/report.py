@@ -511,6 +511,37 @@ def heartbeat_summary(events):
     return out
 
 
+def numerics_summary(events):
+    """Numeric-health rollup from schema-v14 'numerics' events
+    (--numerics runs; ISSUE 20): rounds observed, total nonfinite
+    count across every stage counter, rounds with any decision inside
+    the tie band (tie-locked — the Bulyan-collapse signature when
+    pinned at the round count), the peak tie-proximity count, and the
+    peak cancellation depth in bits."""
+    from attacking_federate_learning_tpu.utils.numerics import (
+        numerics_series
+    )
+
+    series = numerics_series(events)
+    if not series:
+        return None
+    rounds = sorted({r for v in series.values() for r, _ in v})
+    out = {"rounds": len(rounds),
+           "nonfinite_total": int(sum(
+               v for _, v in series.get("nonfinite_total", []))),
+           "tie_locked_rounds": sum(
+               1 for _, v in series.get("tie_locked", []) if v)}
+    ties = [v for key, vals in series.items()
+            if key.endswith("tie_rows") for _, v in vals]
+    if ties:
+        out["tie_rows_max"] = int(max(ties))
+    bits = [v for key, vals in series.items()
+            if key.endswith("cancel_bits") for _, v in vals]
+    if bits:
+        out["cancel_bits_max"] = round(float(max(bits)), 2)
+    return out
+
+
 def summarize_run(events):
     """One run's report payload from its event list."""
     kinds = Counter(e["kind"] for e in events)
@@ -567,6 +598,9 @@ def summarize_run(events):
     hb = heartbeat_summary(events)
     if hb:
         out["heartbeat"] = hb
+    nm = numerics_summary(events)
+    if nm:
+        out["numerics"] = nm
     profiles = [e for e in events if e["kind"] == "profile"]
     if profiles:
         out["phases"] = profiles[-1]["phases"]
@@ -697,6 +731,17 @@ def _print_run(path, s, out):
                 f"{hb['rss_mb_last']:.0f} MB")
         if "rounds_per_s_last" in hb:
             line += f", {hb['rounds_per_s_last']:.2f} rounds/s"
+        out(line)
+    nm = s.get("numerics")
+    if nm:
+        line = (f"  numerics: {nm['rounds']} rounds observed, "
+                f"nonfinite total {nm['nonfinite_total']}, "
+                f"tie-locked {nm['tie_locked_rounds']}/{nm['rounds']} "
+                f"rounds")
+        if "tie_rows_max" in nm:
+            line += f", max tie rows {nm['tie_rows_max']}"
+        if "cancel_bits_max" in nm:
+            line += f", max cancellation {nm['cancel_bits_max']} bits"
         out(line)
     if "phases" in s:
         out("  phase timing:")

@@ -81,7 +81,8 @@ _COMPARE_FIELDS = ("source", "status", "attempts", "rounds_committed",
 
 # Per-round event kinds whose payloads witness the trajectory; 't'
 # (wall clock) and 'v' (schema stamp) are not trajectory.
-_TRAJ_KINDS = ("round", "eval", "asr", "defense", "attack", "fault")
+_TRAJ_KINDS = ("round", "eval", "asr", "defense", "attack", "fault",
+               "margin", "numerics")
 _NON_TRAJ_FIELDS = {"t", "v"}
 
 
@@ -182,6 +183,19 @@ def diff_trajectories(events_a, events_b, band: int = 0) -> dict:
                 out["divergence_kind"] = kind
                 out["divergence_fields"] = {
                     k: [pa.get(k), pb.get(k)] for k in bad[:5]}
+                if kind in ("margin", "numerics"):
+                    # The observatory events carry their own stage
+                    # attribution: name WHERE in the round pipeline
+                    # the first mismatch sits and how big it is in
+                    # f32 ulp (utils/numerics.py:FIELD_STAGE).
+                    from attacking_federate_learning_tpu.utils import (
+                        numerics as N
+                    )
+                    stage, ulp, anchor = N.divergence_attribution(
+                        out["divergence_fields"], kind=kind)
+                    out["divergence_stage"] = stage
+                    out["divergence_ulp"] = ulp
+                    out["divergence_anchor"] = anchor
                 return out
     if shared and band == 0:
         out["bit_identical"] = True
@@ -245,6 +259,11 @@ def _print_diff(d, out=print):
         out(f"  trajectory: first divergence at round "
             f"{tr['divergence_round']} in '{tr['divergence_kind']}' "
             f"[{fields}]")
+        if tr.get("divergence_stage") is not None:
+            ulp = tr.get("divergence_ulp")
+            size = f"{ulp} ulp" if ulp is not None else "non-numeric"
+            out(f"    stage: {tr['divergence_stage']} via field "
+                f"'{tr['divergence_anchor']}' ({size})")
 
 
 def _refresh(reg, args):
@@ -909,6 +928,87 @@ def cmd_margins(reg, args):
     return 0
 
 
+def cmd_numerics(reg, args):
+    """Numeric-health trajectories from a run's schema-v14 'numerics'
+    events (--numerics runs; utils/numerics.py:numerics_series):
+    per-round nonfinite counts by stage, gradient-norm dynamic range,
+    tie-proximity and cancellation-depth counters, plus the tie-lock
+    rollup.  With a second query, report per-field determinism drift
+    instead — the first round where the two runs' series differ
+    (utils/numerics.py:numerics_drift; same-seed twins must report
+    none).  Exit 1 when a run carries no numerics events."""
+    from attacking_federate_learning_tpu.utils.numerics import (
+        numerics_drift, numerics_series
+    )
+
+    ents = [reg.resolve(args.query, args.filter)]
+    if args.b is not None:
+        ents.append(reg.resolve(args.b, args.filter))
+    series = []
+    for e in ents:
+        s = numerics_series(_load_run_events(e))
+        if not s:
+            print(f"run {e['run_id']}: no numerics events — rerun "
+                  f"with --numerics (schema v14+)")
+            return 1
+        series.append(s)
+    if args.json:
+        print(json.dumps({e["run_id"]: {f: list(map(list, v))
+                                        for f, v in s.items()}
+                          for e, s in zip(ents, series)}))
+        return 0
+
+    def _cell(v):
+        if isinstance(v, float) and not v.is_integer():
+            return f"{v:>12.4f}"
+        return f"{int(v):>12d}"
+
+    if len(ents) == 1:
+        s = series[0]
+        fields = sorted(s)
+        rounds = sorted({r for v in s.values() for r, _ in v})
+        print(f"== {ents[0]['run_id']} ==")
+        print("  round " + "".join(f"{f:>16}"[-16:] for f in fields))
+        by_f = {f: dict(s[f]) for f in fields}
+        for r in rounds:
+            print(f"  {r:>5} " + "".join(
+                f"{'':>4}" + (_cell(by_f[f][r]) if r in by_f[f]
+                              else f"{'-':>12}") for f in fields))
+        nf = [v for _, v in s.get("nonfinite_total", [])]
+        locked = [r for r, v in s.get("tie_locked", []) if v]
+        ties = [v for _, v in s.get("tie_rows", [])]
+        print(f"  health: nonfinite_total sum {int(sum(nf))}, "
+              f"tie-locked {len(locked)}/{len(rounds)} rounds"
+              + (f" (rounds {' '.join(map(str, locked[:8]))}"
+                 + ("..." if len(locked) > 8 else "") + ")"
+                 if locked else "")
+              + (f", max tie_rows {int(max(ties))}" if ties else ""))
+        return 0
+
+    a, b = series
+    ida, idb = ents[0]["run_id"], ents[1]["run_id"]
+    print(f"== numerics drift: {ida} vs {idb} ==")
+    drifted = False
+    for f in sorted(set(a) | set(b)):
+        if f not in a or f not in b:
+            print(f"  {f}: only in {ida if f in a else idb}")
+            drifted = True
+            continue
+        hit = numerics_drift(a, b, field=f)
+        if hit is None:
+            continue
+        r, va, vb = hit
+        drifted = True
+        print(f"  {f}: first drift at round {r} "
+              f"({_fmt(va)} vs {_fmt(vb)})")
+    if not drifted:
+        shared = len({r for v in a.values() for r, _ in v}
+                     & {r for v in b.values() for r, _ in v})
+        print(f"  deterministic twins: every shared field agrees over "
+              f"{shared} shared rounds")
+    return 0
+
+
 def cmd_selfcheck(reg, args):
     """CI self-check (tools/smoke.sh leg 6): two refreshes must agree
     (incremental refresh is idempotent over an unchanged store), every
@@ -1058,6 +1158,16 @@ def main(argv=None) -> int:
     sp.add_argument("b", nargs="?", default=None,
                     help="second run: drift of B against the first")
     sp.set_defaults(fn=cmd_margins)
+    sp = sub.add_parser("numerics",
+                        help="numeric-health trajectories from v14 "
+                             "'numerics' events (--numerics runs); a "
+                             "second query reports per-field "
+                             "determinism drift (first differing "
+                             "round)")
+    sp.add_argument("query")
+    sp.add_argument("b", nargs="?", default=None,
+                    help="second run: drift of B against the first")
+    sp.set_defaults(fn=cmd_numerics)
     sp = sub.add_parser("selfcheck",
                         help="CI: refresh idempotence + resolvability")
     sp.set_defaults(fn=cmd_selfcheck)

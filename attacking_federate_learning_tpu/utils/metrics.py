@@ -86,7 +86,16 @@ with the hierarchical shard-domain fields (core/faults.py ISSUE 19:
 and domain death, ``shards_dead`` / ``shards_alive`` — the correlated
 shard-DOMAIN accounting, and ``tier2_action`` — the host-planned
 remask/fallback/hold ladder decision at tier-2), all host-replayable
-from the fault key (tools/fault_matrix.py diffs them exactly).
+from the fault key (tools/fault_matrix.py diffs them exactly); v14
+adds ``numerics`` — one numeric-health record per round under
+``--numerics`` (core/engine.py + utils/numerics.py): per-stage
+nonfinite counts (pre/post quarantine, post-aggregate), the
+gradient-norm dynamic range, the distance-Gram cancellation-depth
+estimate, and the tie-proximity counters that band the PR 18 margin
+tensors at k ulp of their decision boundary, rolled up host-side into
+nonfinite_total / tie_locked (read with ``runs numerics``; the
+cross-implementation envelopes live in NUMERICS_BASELINE.json, gated
+by tools/numerics_gate.py).
 Readers accept every version; older logs simply never carry the newer
 kinds, and a newer-only kind stamped with an older version is an
 emitter bug, rejected (``KIND_MIN_VERSION``).
@@ -104,8 +113,8 @@ from typing import Optional
 import numpy as np
 
 
-SCHEMA_VERSION = 13
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13)
+SCHEMA_VERSION = 14
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14)
 
 # kind -> required fields.  Producers: core/engine.py (round, eval, asr,
 # profile, stream, defense, attack, selection_hist via RunLogger).
@@ -240,6 +249,16 @@ EVENT_KINDS = {
     # 'tier2_margin_*' with their own rollups) and traffic's f_eff
     # when a --traffic-population schedule rides along
     "margin": {"round", "defense"},
+    # --- v14: the numerics & determinism observatory (utils/numerics.py)
+    # one record per round under --numerics: per-stage nonfinite counts
+    # (nonfinite_pre / nonfinite_post / nonfinite_agg), the gradient-
+    # norm dynamic range (range_log2), the tie-proximity counters read
+    # off the PR 18 margin tensors (tie_rows, banded at tie_band_ulps
+    # of the decision boundary's own f32 spacing), the distance-Gram
+    # cancellation-depth estimate (cancel_bits), the hierarchical
+    # per-shard/tier-2 stacks on the same names ('shard_*'/'tier2_*'),
+    # and the host rollups (nonfinite_total, tie_locked)
+    "numerics": {"round", "defense"},
 }
 
 # Minimum schema version per kind introduced after v1; an event carrying
@@ -250,7 +269,8 @@ KIND_MIN_VERSION = {"compile": 2, "cost": 2, "heartbeat": 2,
                     "secagg": 5, "shard_selection": 6, "forensics": 6,
                     "async": 7, "campaign": 8,
                     "stage_cost": 9, "wire_bytes": 9,
-                    "wall": 10, "traffic": 11, "margin": 12}
+                    "wall": 10, "traffic": 11, "margin": 12,
+                    "numerics": 14}
 
 # Back-compat alias (pre-v3 spelling used by external readers).
 V2_KINDS = {k for k, v in KIND_MIN_VERSION.items() if v == 2}

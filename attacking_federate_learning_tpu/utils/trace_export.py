@@ -240,6 +240,21 @@ def events_to_trace(events, name: str = "run") -> dict:
                 trace.append({"name": "colluder_margin", "ph": "C",
                               "pid": pid, "tid": 0, "ts": _us(t),
                               "args": {"colluder_margin": float(cm)}})
+        elif kind == "numerics":
+            # Numeric-health ledger (schema v14, --numerics): one
+            # counter track per round for the health scalars a viewer
+            # can eyeball — nonfinite total, tie-proximity count, and
+            # cancellation depth.  Hier stacks are lists; only finite
+            # scalars draw points (same NaN rule as the margin track).
+            vals = {}
+            for f in ("nonfinite_total", "tie_rows", "cancel_bits"):
+                v = e.get(f)
+                if isinstance(v, (int, float)) and math.isfinite(v):
+                    vals[f] = float(v)
+            if vals:
+                trace.append({"name": "numerics", "ph": "C",
+                              "pid": pid, "tid": 0, "ts": _us(t),
+                              "args": vals})
         elif kind in _INSTANT_KINDS:
             label = kind if kind != "lifecycle" else (
                 f"lifecycle:{e.get('phase', '?')}")

@@ -2,6 +2,8 @@
 (geometric median / RFA, norm bounding) — property tests + engine/CLI
 integration."""
 
+import math
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -179,14 +181,46 @@ def test_dnc_filters_spectral_outliers():
     # the sketch (top singular value ~ sqrt(r) ~ 45) to be spectrally
     # identifiable — same condition the DnC paper's threat model assumes.
     G[:f] += 100.0 * direction / np.linalg.norm(direction)
-    out = np.asarray(dnc(jnp.asarray(G), n, f))
+    agg, diag = dnc(jnp.asarray(G), n, f, telemetry=True)
+    out = np.asarray(agg)
+    w = np.asarray(diag["survivor_mask"])
     honest_mean = G[f:].mean(axis=0)
-    full_mean = G.mean(axis=0)
-    # The colluding direction is the top singular direction; DnC's
-    # aggregate sits much nearer the honest mean than the poisoned mean
-    # (the residual is honest-subset jitter, not malicious mass).
-    assert (np.linalg.norm(out - honest_mean)
-            < 0.5 * np.linalg.norm(full_mean - honest_mean))
+    # f64-adjudicated (ISSUE 20, utils/numerics.py): this is NOT a
+    # floating-point near-tie — every per-iteration removal boundary
+    # gap measures >= 4e5 f32 ulp on this cohort (decisively outside
+    # TIE_BAND_ULPS) and the f32 aggregate matches the f64
+    # recomputation of the same survivor mean to ulps.  The filtering
+    # claim is therefore asserted directly: no colluder survives any
+    # iteration's spectral cut.
+    assert not w[:f].any(), "a colluder survived the spectral filter"
+    assert w.sum() > 0
+    # The residual against the full honest mean is honest-subset
+    # jitter, not malicious mass: with k of (n - f) iid N(0,1) honest
+    # survivors its expected norm is sqrt(d * (1/k - 1/(n-f)))
+    # (~12.3 at the measured k=10), which the old 0.5 *
+    # ||full - honest|| threshold (10.6) undershot.  1.5x the
+    # predicted jitter bounds it with slack while still failing if any
+    # malicious mass (norm ~100) leaks into the aggregate.
+    k = int(w.sum())
+    jitter = math.sqrt(d * max(1.0 / k - 1.0 / (n - f), 0.0))
+    assert np.linalg.norm(out - honest_mean) <= 1.5 * jitter, (
+        f"DnC residual {np.linalg.norm(out - honest_mean):.2f} exceeds "
+        f"1.5x the k={k} honest-survivor jitter {jitter:.2f}")
+    # And the aggregate IS the survivor mean: the f32 reduction sits
+    # within the tie band of the f64 referee when banded at the
+    # aggregate's own largest magnitude (the tie_proximity convention
+    # — per-coordinate ulp counts are meaningless at the near-zero
+    # coordinates of a centered mean; measured 1.07 ulp-at-scale
+    # here).
+    from attacking_federate_learning_tpu.utils.numerics import (
+        TIE_BAND_ULPS
+    )
+    ref64 = G[w > 0].astype(np.float64).mean(axis=0)
+    band = TIE_BAND_ULPS * (2.0 ** -23) * float(np.max(np.abs(ref64)))
+    worst = float(np.max(np.abs(out - ref64)))
+    assert worst <= band, (
+        f"aggregate is {worst:.3e} from the f64 survivor mean — "
+        f"outside the {TIE_BAND_ULPS}-ulp-at-scale band {band:.3e}")
 
 
 def test_dnc_zero_f_is_exact_mean():

@@ -398,13 +398,14 @@ def test_hier_config_validation(tmp_path):
 def test_hier_engine_rejects_unsupported_combos(tmp_path):
     # NOTE (ISSUE 8): telemetry/log_round_stats are no longer in this
     # matrix — they are supported hierarchical compositions now (the
-    # per-shard diagnostics ride the scan as (S, m) stacks); the
-    # remaining rejections pin only the still-unsupported set.
+    # per-shard diagnostics ride the scan as (S, m) stacks); ISSUE 19
+    # likewise removed fault injection (per-shard quarantine masks
+    # inside the scan step, tests/test_hier_faults.py); the remaining
+    # rejections pin only the still-unsupported set.
     ds = _dataset()
     for kw, match in (
             (dict(participation=0.5), "participation"),
             (dict(data_placement="host_stream"), "device"),
-            (dict(faults=C.FaultConfig(dropout=0.2)), "fault"),
             (dict(defense="GeoMedian"), "tier-1"),
             (dict(distance_impl="host"), "distance_impl"),
             (dict(trimmed_mean_impl="host"), "trimmed_mean_impl"),

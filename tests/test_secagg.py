@@ -360,10 +360,9 @@ _CONFIG_REJECTS = [
 ]
 
 # PR 6's hierarchical rejections, pinned to flag-naming messages too
-# (minus telemetry/round-stats — supported since ISSUE 8).
+# (minus telemetry/round-stats — supported since ISSUE 8 — and fault
+# injection — supported since ISSUE 19, tests/test_hier_faults.py).
 _ENGINE_REJECTS = [
-    (dict(aggregation="hierarchical", megabatch=4,
-          faults=FaultConfig(dropout=0.2)), "fault"),
     (dict(aggregation="hierarchical", megabatch=4, participation=0.5),
      "participation"),
     (dict(aggregation="hierarchical", megabatch=4,

@@ -343,7 +343,14 @@ def test_schema_roundtrip_every_engine_kind(tmp_path):
                      defense="Krum", epochs=3, test_step=3,
                      margins=True)
     _, ev8 = _run(cfg8, tmp_path, "roundtrip8")
-    for rec in ev1 + ev2 + ev3 + ev4 + ev5 + ev6 + ev7 + ev8:
+    # Run 9: numerics observatory — the v14 'numerics' kind from a
+    # real engine run (utils/numerics.py health counters + rollups,
+    # one event per round).
+    cfg9 = _tele_cfg(tmp_path, users_count=12, mal_prop=0.25,
+                     defense="Krum", epochs=3, test_step=3,
+                     numerics=True)
+    _, ev9 = _run(cfg9, tmp_path, "roundtrip9")
+    for rec in ev1 + ev2 + ev3 + ev4 + ev5 + ev6 + ev7 + ev8 + ev9:
         validate_event(rec)
         assert rec["v"] == SCHEMA_VERSION
         seen.add(rec["kind"])

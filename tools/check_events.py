@@ -43,7 +43,14 @@ utilization — plus v13's hierarchical shard-domain 'fault' fields:
 the per-shard survivor-count vector (shard_alive), the correlated
 shard-DOMAIN accounting (shards_dead / shards_alive) and the
 host-planned tier-2 ladder decision (tier2_action), all replayable
-from the fault key via core/faults.py:hier_fault_schedule).  An
+from the fault key via core/faults.py:hier_fault_schedule — plus
+v14's 'numerics' kind: one numeric-health record per round under
+--numerics runs, core/engine.py + utils/numerics.py — per-stage
+nonfinite counts, gradient-norm dynamic range, distance-Gram
+cancellation depth and the tie-proximity counters banded at k ulp of
+the PR 18 margin boundaries, with the nonfinite_total / tie_locked
+rollups; the cross-implementation ulp envelopes these counters
+explain live in NUMERICS_BASELINE.json, tools/numerics_gate.py).  An
 event stamped with a
 version this reader does not know is reported as "produced by a newer
 writer" — a clear per-line error, never a KeyError — and a newer-only

@@ -709,6 +709,94 @@ def stageproof(cells=None) -> int:
           f"{len([c for c in names if c in STAGEPROOF['fingerprint_cells']])} "
           f"scopes-off twins fingerprint-identical, hier "
           f"tier1_to_tier2 == S*d*4{spmd}")
+    return numproof()
+
+
+# --- numerics-observatory proof (ISSUE 20 acceptance) ------------------
+# Baseline-free like the memproof.  The numerics observatory
+# (utils/numerics.py counters threaded via cfg.numerics) must be a
+# pure trace-time observer:
+#
+# (a) kernel twin: each margin-bearing defense kernel jitted with NO
+#     observatory kwargs lowers to HLO text byte-identical to the
+#     explicit margins=False, numerics=False spelling — the kwargs
+#     leave zero residue when off (the off-path COST identity across
+#     all 62 baseline entry points is pinned by the main gate, which
+#     chains into this proof);
+# (b) behavioral twin: a numerics-ON pinned experiment reaches
+#     bit-identical weights to its numerics-OFF twin — the counters
+#     observe the round, they never steer it.
+
+NUMPROOF = dict(rounds=3, cells=("krum", "hier_krum"))
+
+
+def numproof() -> int:
+    """Gate the numerics-observatory observer facts.  Returns 0
+    clean, 1 on a violation.  No baseline: HLO-text identity and
+    weight bit-identity are absolute."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from attacking_federate_learning_tpu.defenses.kernels import (
+        bulyan, krum, trimmed_mean
+    )
+    from attacking_federate_learning_tpu.defenses.median import median
+
+    problems = []
+    G = jnp.zeros((12, 32), jnp.float32)
+    kernels = {
+        "krum": (lambda g: krum(g, 12, 2, telemetry=True),
+                 lambda g: krum(g, 12, 2, telemetry=True,
+                                margins=False, numerics=False)),
+        "trimmed_mean": (
+            lambda g: trimmed_mean(g, 12, 2, telemetry=True),
+            lambda g: trimmed_mean(g, 12, 2, telemetry=True,
+                                   margins=False, numerics=False)),
+        "median": (lambda g: median(g, 12, 2, telemetry=True),
+                   lambda g: median(g, 12, 2, telemetry=True,
+                                    margins=False, numerics=False)),
+        "bulyan": (lambda g: bulyan(g, 12, 2, telemetry=True),
+                   lambda g: bulyan(g, 12, 2, telemetry=True,
+                                    margins=False, numerics=False)),
+    }
+    for name, (bare, explicit) in kernels.items():
+        t_bare = jax.jit(bare).lower(G).as_text()
+        t_off = jax.jit(explicit).lower(G).as_text()
+        if t_bare != t_off:
+            problems.append(
+                f"numproof[{name}]: margins=False, numerics=False "
+                f"lowers to different HLO than the bare call — the "
+                f"observatory kwargs leave residue when off")
+
+    for cell in NUMPROOF["cells"]:
+        exp_off = _pinned_experiment(CELLS[cell])
+        exp_on = _pinned_experiment({**CELLS[cell], "numerics": True})
+        for t in range(NUMPROOF["rounds"]):
+            exp_off.run_round(t)
+            exp_on.run_round(t)
+        w_off = np.asarray(exp_off.state.weights)
+        w_on = np.asarray(exp_on.state.weights)
+        if not np.array_equal(w_off.view(np.uint32),
+                              w_on.view(np.uint32)):
+            bad = int(np.sum(w_off.view(np.uint32)
+                             != w_on.view(np.uint32)))
+            problems.append(
+                f"numproof[{cell}]: numerics-ON weights diverged from "
+                f"the OFF twin after {NUMPROOF['rounds']} rounds "
+                f"({bad} coords differ) — the counters steered the "
+                f"round")
+
+    if problems:
+        print(f"FAIL perf_gate --numproof: {len(problems)} "
+              f"violation(s)")
+        for prob in problems:
+            print(f"  {prob}")
+        return 1
+    print(f"ok   perf_gate numproof: {len(kernels)} kernel twins "
+          f"HLO-text identical with the observatory kwargs off, "
+          f"{len(NUMPROOF['cells'])} numerics-ON cells bit-identical "
+          f"to their OFF twins over {NUMPROOF['rounds']} rounds")
     return 0
 
 
@@ -814,6 +902,14 @@ def main(argv=None) -> int:
                         "twin fingerprints match), and the "
                         "hierarchical wire ledger's tier1_to_tier2 "
                         "seam equals S*d*4 (honors --cells)")
+    p.add_argument("--numproof", action="store_true",
+                   help="run ONLY the numerics-observatory proof "
+                        "(ISSUE 20): every margin-bearing kernel's "
+                        "bare call lowers to HLO text identical to "
+                        "the explicit margins=False, numerics=False "
+                        "spelling, and numerics-ON pinned cells "
+                        "reach bit-identical weights to their OFF "
+                        "twins (the counters observe, never steer)")
     args = p.parse_args(argv)
 
     # The shard proof needs an 8-device mesh; the flag must land
@@ -828,6 +924,8 @@ def main(argv=None) -> int:
         return shardproof()
     if args.pallasproof and not args.memproof:
         return pallasproof()
+    if args.numproof and not args.memproof:
+        return numproof()
 
     cells = [c.strip() for c in args.cells.split(",") if c.strip()]
     unknown = [c for c in cells if c not in CELLS]

@@ -107,33 +107,33 @@ fail=0
 shopt -s nullglob
 jsonls=(logs/*.jsonl)
 if [ ${#jsonls[@]} -gt 0 ]; then
-    echo "== smoke 1/15: check_events (${#jsonls[@]} logs) =="
+    echo "== smoke 1/16: check_events (${#jsonls[@]} logs) =="
     python tools/check_events.py "${jsonls[@]}" || fail=1
 else
-    echo "== smoke 1/15: check_events — no logs/*.jsonl yet, skipped =="
+    echo "== smoke 1/16: check_events — no logs/*.jsonl yet, skipped =="
 fi
 
 crash_work=""
 if [ "${1:-}" != "--fast" ]; then
-    echo "== smoke 2/15: fault_matrix =="
+    echo "== smoke 2/16: fault_matrix =="
     python tools/fault_matrix.py || fail=1
-    echo "== smoke 3/15: crash_matrix (supervised preempt/resume) =="
+    echo "== smoke 3/16: crash_matrix (supervised preempt/resume) =="
     # Keep the matrix's run stores: leg 6 registry-checks them.
     crash_work="$(mktemp -d -t crash_matrix_XXXXXX)"
     python tools/crash_matrix.py --workdir "$crash_work" || fail=1
 else
-    echo "== smoke 2/15: fault_matrix — skipped (--fast) =="
-    echo "== smoke 3/15: crash_matrix — skipped (--fast) =="
+    echo "== smoke 2/16: fault_matrix — skipped (--fast) =="
+    echo "== smoke 3/16: crash_matrix — skipped (--fast) =="
 fi
 
-echo "== smoke 4/15: perf_gate (+ memproof + wireproof + pallasproof"
+echo "== smoke 4/16: perf_gate (+ memproof + wireproof + pallasproof"
 echo "   + shardproof + stageproof) =="
 python tools/perf_gate.py --memproof || fail=1
 
-echo "== smoke 5/15: science_gate (behavioral drift) =="
+echo "== smoke 5/16: science_gate (behavioral drift) =="
 python tools/science_gate.py || fail=1
 
-echo "== smoke 6/15: runs selfcheck (registry) =="
+echo "== smoke 6/16: runs selfcheck (registry) =="
 python -m attacking_federate_learning_tpu.cli runs selfcheck || fail=1
 if [ -n "$crash_work" ]; then
     # The registry over the crash matrix's preempt/resume artifacts:
@@ -150,7 +150,7 @@ if [ -n "$crash_work" ]; then
     rm -rf "$crash_work"
 fi
 
-echo "== smoke 7/15: hierarchical aggregation (journaled, audited) =="
+echo "== smoke 7/16: hierarchical aggregation (journaled, audited) =="
 hier_work="$(mktemp -d -t hier_smoke_XXXXXX)"
 for def in Krum TrimmedMean; do
     python -m attacking_federate_learning_tpu.cli \
@@ -176,7 +176,7 @@ sys.exit(bad)
 PY
 rm -rf "$hier_work"
 
-echo "== smoke 8/15: secure aggregation (journaled, audited) =="
+echo "== smoke 8/16: secure aggregation (journaled, audited) =="
 sa_work="$(mktemp -d -t secagg_smoke_XXXXXX)"
 # vanilla: one dropout-rate high enough that the 5-round seeded run is
 # guaranteed (and pinned by the audit below) to include at least one
@@ -225,7 +225,7 @@ sys.exit(bad)
 PY
 rm -rf "$sa_work"
 
-echo "== smoke 9/15: hierarchical telemetry + forensics (journaled) =="
+echo "== smoke 9/16: hierarchical telemetry + forensics (journaled) =="
 fx_work="$(mktemp -d -t hier_tele_smoke_XXXXXX)"
 # 5-round journaled hierarchical x Krum run with --telemetry: the run
 # must emit one schema-v6 'shard_selection' event per round.
@@ -262,7 +262,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     trace hier_tele_smoke -o "$fx_work/trace.json" || fail=1
 rm -rf "$fx_work"
 
-echo "== smoke 10/15: asynchronous rounds (journaled, audited) =="
+echo "== smoke 10/16: asynchronous rounds (journaled, audited) =="
 as_work="$(mktemp -d -t async_smoke_XXXXXX)"
 # 5-round journaled FedBuff runs: k=8 of n=12 aggregated per applied
 # round, staleness bound 2, poly weighting, Krum + TrimmedMean.
@@ -312,7 +312,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     async async_Krum_smoke || fail=1
 rm -rf "$as_work"
 
-echo "== smoke 11/15: campaign engine (kill + resume, audited) =="
+echo "== smoke 11/16: campaign engine (kill + resume, audited) =="
 ce_work="$(mktemp -d -t campaign_smoke_XXXXXX)"
 cat > "$ce_work/spec.json" <<SPEC
 {"name": "smoke",
@@ -364,7 +364,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     campaign "$camp_id" || fail=1
 rm -rf "$ce_work"
 
-echo "== smoke 12/15: measured walls (profiled run + wall gate) =="
+echo "== smoke 12/16: measured walls (profiled run + wall gate) =="
 wl_work="$(mktemp -d -t walls_smoke_XXXXXX)"
 # 5-round journaled flat x Krum with every eval interval profiled: the
 # engine books each span capture onto the stage taxonomy and emits
@@ -410,7 +410,7 @@ python tools/wall_gate.py --update --baseline "$wl_work/WALL_BASELINE.json" \
 python tools/wall_gate.py --baseline "$wl_work/WALL_BASELINE.json" || fail=1
 rm -rf "$wl_work"
 
-echo "== smoke 13/15: population traffic (churn, ladder, audited) =="
+echo "== smoke 13/16: population traffic (churn, ladder, audited) =="
 tr_work="$(mktemp -d -t traffic_smoke_XXXXXX)"
 # 10-round journaled churn run from an unreliable 16-client population:
 # the sampled cohort routinely misses Krum's 2f+3 validity bound, so
@@ -470,7 +470,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     traffic traffic_smoke || fail=1
 rm -rf "$tr_work"
 
-echo "== smoke 14/15: robustness margins (v12 audit + drift render) =="
+echo "== smoke 14/16: robustness margins (v12 audit + drift render) =="
 mg_work="$(mktemp -d -t margins_smoke_XXXXXX)"
 # Two short journaled Bulyan --margins runs at different seeds: the
 # in-jit margin observatory emits one schema-v12 'margin' event per
@@ -520,7 +520,7 @@ python -m attacking_federate_learning_tpu.cli runs \
     margins margins_smoke_0 margins_smoke_1 || fail=1
 rm -rf "$mg_work"
 
-echo "== smoke 15/15: faulted hierarchy (shard domains, journaled) =="
+echo "== smoke 15/16: faulted hierarchy (shard domains, journaled) =="
 fh_work="$(mktemp -d -t fault_hier_smoke_XXXXXX)"
 # A journaled 6-round two-tier run under BOTH fault granularities:
 # per-client dropout/corrupt inside each megabatch plus correlated
@@ -602,6 +602,61 @@ PY
 python -m attacking_federate_learning_tpu.cli report \
     "$fh_work/logs/fault_hier_smoke.jsonl" || fail=1
 rm -rf "$fh_work"
+
+echo "== smoke 16/16: numerics observatory (v14 audit + drift gate) =="
+nm_work="$(mktemp -d -t numerics_smoke_XXXXXX)"
+# A short journaled --numerics run: the in-jit numeric-health
+# observatory emits one schema-v14 'numerics' event per round
+# (nonfinite by stage, norm dynamic range, tie proximity at the
+# decision boundaries, Gram cancellation depth).
+python -m attacking_federate_learning_tpu.cli \
+    -d Krum -z 1.5 -s SYNTH_MNIST -n 12 -m 0.2 -c 16 -e 5 \
+    --synth-train 256 --synth-test 64 --seed 0 \
+    --numerics \
+    --journal --run-id numerics_smoke --no-checkpoint \
+    --log-dir "$nm_work/logs" --run-dir "$nm_work/runs" \
+    > /dev/null || fail=1
+# The private log validates (v14 'numerics' events included) and the
+# --stats histogram renders.
+python tools/check_events.py --stats \
+    "$nm_work/logs/numerics_smoke.jsonl" || fail=1
+# Numerics-event audit: one per round, stage counters + rollups along.
+python - "$nm_work" <<'PY' || fail=1
+import json, os, sys
+events = [json.loads(line) for line in
+          open(os.path.join(sys.argv[1], "logs",
+                            "numerics_smoke.jsonl"))]
+nm = [e for e in events if e.get("kind") == "numerics"]
+problems = []
+if len(nm) != 5:
+    problems.append(f"{len(nm)} numerics events, want one per round")
+if any(e.get("v", 0) < 14 for e in nm):
+    problems.append("numerics event stamped below v14")
+need = ("nonfinite_pre", "nonfinite_post", "nonfinite_agg",
+        "range_log2", "tie_rows", "cancel_bits", "nonfinite_total",
+        "tie_locked", "tie_band_ulps")
+if any(k not in e for e in nm for k in need):
+    problems.append("a numerics event is missing its counters")
+if any(e.get("nonfinite_total", -1) != 0 for e in nm):
+    problems.append("nonfinite gradients in a healthy seeded run")
+status = "ok" if not problems else f"FAIL {problems}"
+print(f"  numerics numerics_smoke: {len(nm)} events ({status})")
+sys.exit(bool(problems))
+PY
+# Registry-resolved health-trajectory table must render (runs
+# numerics verb, exit 0).
+python -m attacking_federate_learning_tpu.cli runs \
+    --run-dir "$nm_work/runs" --bench '' --progress '' \
+    numerics numerics_smoke || fail=1
+# Cross-impl divergence ledger round-trip: regenerate a baseline into
+# the temp dir, then gate against it — a fresh ledger must gate clean
+# on the same host (the checked-in NUMERICS_BASELINE.json is the
+# cross-session pin; tools/numerics_gate.py).
+python tools/numerics_gate.py --update \
+    --baseline "$nm_work/NUMERICS_BASELINE.json" || fail=1
+python tools/numerics_gate.py --strict-env \
+    --baseline "$nm_work/NUMERICS_BASELINE.json" || fail=1
+rm -rf "$nm_work"
 
 if [ $fail -ne 0 ]; then
     echo "SMOKE FAILED"
